@@ -1,0 +1,28 @@
+"""Test fixture: an 8-device virtual CPU mesh (SURVEY.md §4).
+
+Environment must be set before the first `import jax` anywhere in the test
+process, hence module scope here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The environment may have imported jax at interpreter startup (sitecustomize
+# PJRT plugins), capturing JAX_PLATFORMS before this module ran — force the
+# platform through the config as well, which works until first backend use.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tpu_compressed_dp.parallel.mesh import make_data_mesh
+
+    assert len(jax.devices()) >= 8, "expected 8 virtual CPU devices"
+    return make_data_mesh(8)

@@ -1,0 +1,142 @@
+"""Unit tests for the six compression operators (SURVEY.md §4 test pyramid).
+
+Numerics are checked against closed-form properties: exact keep counts for
+Top-K/Random-K, threshold semantics, unbiasedness of the stochastic
+quantisers, and the reference's tie-keeping Top-K rule.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.ops import compressors as C
+
+
+def rand_grad(n=1000, seed=0):
+    return jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
+
+
+class TestTopK:
+    def test_keep_count_matches_reference_rule(self):
+        # reference: threshold at kthvalue(ceil(n*(1-K))), keep >= (core.py:181)
+        for n, k in [(100, 0.1), (100, 0.5), (97, 0.33), (10, 0.01), (1000, 0.001)]:
+            g = np.asarray(rand_grad(n, seed=n))
+            out = np.asarray(C.top_k(jnp.asarray(g), ratio=k))
+            import math
+
+            m = max(1, math.ceil(n * (1 - k)))
+            expected = n - m + 1
+            assert np.count_nonzero(out) == expected
+
+    def test_keeps_largest_magnitudes(self):
+        g = jnp.asarray([0.1, -5.0, 0.2, 3.0, -0.05, 1.0], jnp.float32)
+        out = np.asarray(C.top_k(g, ratio=0.5))
+        # n=6, K=0.5 -> m=ceil(3)=3 -> keep 4 largest |g|: -5, 3, 1, 0.2
+        np.testing.assert_allclose(out, [0.0, -5.0, 0.2, 3.0, 0.0, 1.0])
+
+    def test_kept_values_unchanged(self):
+        g = rand_grad(512)
+        out = C.top_k(g, ratio=0.1)
+        mask = out != 0
+        np.testing.assert_array_equal(np.asarray(out)[np.asarray(mask)], np.asarray(g)[np.asarray(mask)])
+
+
+class TestRandomK:
+    def test_keep_count(self):
+        for n, k in [(100, 0.1), (97, 0.33), (1000, 0.5)]:
+            g = jnp.ones((n,), jnp.float32)
+            out = C.random_k(g, jax.random.key(1), ratio=k)
+            assert int(jnp.count_nonzero(out)) == C.randomk_keep_count(n, k)
+
+    def test_uniform_selection(self):
+        # every coordinate selected with probability ~k
+        n, k, trials = 64, 0.25, 400
+        g = jnp.ones((n,), jnp.float32)
+        counts = np.zeros(n)
+        for t in range(trials):
+            counts += np.asarray(C.random_k(g, jax.random.key(t), ratio=k)) != 0
+        freq = counts / trials
+        assert np.all(np.abs(freq - k) < 0.12)
+
+    def test_same_key_same_mask(self):
+        g1, g2 = rand_grad(256, 1), rand_grad(256, 2)
+        m1 = np.asarray(C.random_k(g1, jax.random.key(7), ratio=0.1)) != 0
+        m2 = np.asarray(C.random_k(g2, jax.random.key(7), ratio=0.1)) != 0
+        np.testing.assert_array_equal(m1, m2)
+
+
+class TestThresholdV:
+    def test_semantics(self):
+        g = jnp.asarray([0.5, -0.0005, 0.002, -0.7, 0.0], jnp.float32)
+        out = np.asarray(C.threshold_v(g, threshold=1e-3))
+        np.testing.assert_allclose(out, [0.5, 0.0, 0.002, -0.7, 0.0])
+
+
+class TestAdaptiveThreshold:
+    def test_semantics(self):
+        g = jnp.asarray([1.0, 0.49, 0.51, -0.5, -2.0], jnp.float32)
+        # max|g| = 2 -> keep where 2|g| >= 2 i.e. |g| >= 1
+        out = np.asarray(C.adaptive_threshold(g))
+        np.testing.assert_allclose(out, [1.0, 0.0, 0.0, 0.0, -2.0])
+
+
+class TestTernGrad:
+    def test_values_ternary(self):
+        g = rand_grad(2048)
+        out = np.asarray(C.terngrad(g, jax.random.key(0)))
+        gmax = float(jnp.max(jnp.abs(g)))
+        nz = np.abs(out[out != 0])
+        np.testing.assert_allclose(nz, gmax, rtol=1e-6)
+
+    def test_unbiased(self):
+        g = rand_grad(256)
+        outs = [np.asarray(C.terngrad(g, jax.random.key(s))) for s in range(600)]
+        mean = np.mean(outs, axis=0)
+        np.testing.assert_allclose(mean, np.asarray(g), atol=0.25)
+
+    def test_zero_grad_safe(self):
+        out = C.terngrad(jnp.zeros((16,), jnp.float32), jax.random.key(0))
+        assert np.all(np.isfinite(np.asarray(out)))
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+
+
+class TestRandomDithering:
+    def test_unbiased(self):
+        g = rand_grad(256)
+        outs = [np.asarray(C.random_dithering(g, jax.random.key(s), qstates=4)) for s in range(600)]
+        np.testing.assert_allclose(np.mean(outs, axis=0), np.asarray(g), atol=0.3)
+
+    def test_quantised_levels(self):
+        g = rand_grad(512)
+        out = np.asarray(C.random_dithering(g, jax.random.key(1), qstates=8))
+        norm = float(jnp.linalg.norm(g))
+        levels = np.abs(out) / norm * 8
+        np.testing.assert_allclose(levels, np.round(levels), atol=1e-4)
+
+    def test_zero_grad_safe(self):
+        out = C.random_dithering(jnp.zeros((16,), jnp.float32), jax.random.key(0))
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestRegistry:
+    @pytest.mark.parametrize(
+        "name",
+        ["Topk", "Randomk", "Thresholdv", "AdaptiveThreshold", "TernGrad", "RandomDithering",
+         "topk", "qsgd", "none", None],
+    )
+    def test_reference_spellings_resolve(self, name):
+        b = C.get_compressor(name, ratio=0.1)
+        out = b.fn(rand_grad(64), jax.random.key(0))
+        assert out.shape == (64,)
+
+    def test_unknown_method_raises(self):
+        with pytest.raises(ValueError):
+            C.get_compressor("enitremodel")  # the reference's silent typo (SURVEY §2.3)
+
+    def test_jit_compatible(self):
+        for name in C.REGISTRY:
+            b = C.get_compressor(name, ratio=0.25)
+            f = jax.jit(lambda g, k, b=b: b.fn(g, k))
+            out = f(rand_grad(128), jax.random.key(3))
+            assert out.shape == (128,)

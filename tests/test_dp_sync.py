@@ -1,0 +1,133 @@
+"""Tests for the compressed-DP gradient sync engine on an 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state, make_grad_sync
+
+
+def run_sync(mesh, cfg, grads_per_dev, ef=None, seed=0):
+    """grads_per_dev: pytree whose leaves have leading dim 8 (one slice per device)."""
+    sync = make_grad_sync(cfg, "data")
+    if ef is None:
+        ef = init_ef_state(jax.tree.map(lambda g: g[0], grads_per_dev), cfg)
+
+    def f(g, e):
+        out, new_ef, stats = sync(g, e, jax.random.key(seed))
+        return out, new_ef, stats
+
+    shard_spec = jax.tree.map(lambda _: P("data"), grads_per_dev)
+    # one slice per device in, replicated grads out
+    fn = shard_map(
+        lambda g, e: f(jax.tree.map(lambda x: x[0], g), e),
+        mesh=mesh,
+        in_specs=(shard_spec, P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(grads_per_dev, ef)
+
+
+def make_grads(shape_leading=8, n=64, seed=0):
+    k = jax.random.key(seed)
+    return {
+        "w": jax.random.normal(k, (shape_leading, n), jnp.float32),
+        "b": jax.random.normal(jax.random.fold_in(k, 1), (shape_leading, 8), jnp.float32),
+    }
+
+
+class TestDense:
+    def test_dense_sync_is_mean(self, mesh8):
+        cfg = CompressionConfig(method=None)
+        grads = make_grads()
+        out, _, stats = run_sync(mesh8, cfg, grads)
+        np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(grads["w"]).mean(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["b"]), np.asarray(grads["b"]).mean(0), rtol=1e-5)
+        assert float(stats["sent_elems"]) >= 0
+
+    def test_entiremodel_dense_matches_layerwise(self, mesh8):
+        grads = make_grads()
+        out_l, _, _ = run_sync(mesh8, CompressionConfig(method=None, granularity="layerwise"), grads)
+        out_e, _, _ = run_sync(mesh8, CompressionConfig(method=None, granularity="entiremodel"), grads)
+        for k in out_l:
+            np.testing.assert_allclose(np.asarray(out_l[k]), np.asarray(out_e[k]), rtol=1e-5)
+
+
+class TestCompressed:
+    @pytest.mark.parametrize("gran", ["layerwise", "entiremodel"])
+    def test_topk_sync(self, mesh8, gran):
+        cfg = CompressionConfig(method="topk", ratio=0.25, granularity=gran)
+        grads = make_grads()
+        out, _, stats = run_sync(mesh8, cfg, grads)
+        # Every device compresses its own slice then the results are averaged:
+        # reconstruct expected value with the numpy reference.
+        from tpu_compressed_dp.ops import compressors as C
+
+        if gran == "layerwise":
+            exp_w = np.mean(
+                [np.asarray(C.top_k(grads["w"][d], ratio=0.25)) for d in range(8)], axis=0
+            )
+            np.testing.assert_allclose(np.asarray(out["w"]), exp_w, rtol=1e-5)
+        assert float(stats["sent_elems"]) < float(stats["dense_elems"])
+
+    def test_randomk_per_worker_masks_differ_in_simulate(self, mesh8):
+        # simulate mode folds the worker index into the key (unseeded CIFAR
+        # harness analog): per-device masks differ, so the averaged result has
+        # more nonzeros than one mask's worth.
+        cfg = CompressionConfig(method="randomk", ratio=0.25, granularity="layerwise")
+        grads = {"w": jnp.ones((8, 256), jnp.float32)}
+        out, _, _ = run_sync(mesh8, cfg, grads)
+        nnz = int(jnp.count_nonzero(out["w"]))
+        assert nnz > 64  # > one mask's keep count => masks differed across devices
+
+    def test_randomk_shared_mask(self, mesh8):
+        cfg = CompressionConfig(method="randomk", ratio=0.25, shared_mask=True)
+        grads = {"w": jnp.ones((8, 256), jnp.float32)}
+        out, _, _ = run_sync(mesh8, cfg, grads)
+        nnz = int(jnp.count_nonzero(out["w"]))
+        assert nnz == 64  # identical masks across devices
+
+    def test_num_collectives(self, mesh8):
+        grads = make_grads()
+        _, _, s_l = run_sync(mesh8, CompressionConfig(method="topk", ratio=0.5), grads)
+        _, _, s_e = run_sync(
+            mesh8, CompressionConfig(method="topk", ratio=0.5, granularity="entiremodel"), grads
+        )
+        assert float(s_l["num_collectives"]) == 2.0  # one per parameter tensor
+        assert float(s_e["num_collectives"]) == 1.0  # one for the whole model
+
+
+class TestErrorFeedback:
+    def test_residual_property(self, mesh8):
+        # compressed + residual == accumulated gradient, per leaf per device.
+        cfg = CompressionConfig(method="topk", ratio=0.25, error_feedback=True, shared_mask=True)
+        grads = make_grads()
+        out, new_ef, _ = run_sync(mesh8, cfg, grads)
+        assert set(new_ef.keys()) == {"w", "b"}
+        # After one step from zero EF: residual = g_local - compress(g_local).
+        # Top-K is deterministic, so recompute device 0's compression directly.
+        from tpu_compressed_dp.ops import compressors as C
+
+        for leaf in ("w", "b"):
+            g0 = np.asarray(grads[leaf])[0]
+            res0 = np.asarray(new_ef[leaf])
+            comp0 = np.asarray(C.top_k(jnp.asarray(g0), ratio=0.25))
+            np.testing.assert_allclose(res0, g0 - comp0, rtol=1e-6)
+
+    def test_ef_accumulates_small_grads(self, mesh8):
+        # A coordinate never selected by Top-K accumulates in the residual so
+        # it is eventually sent (the EF convergence mechanism).
+        cfg = CompressionConfig(method="topk", ratio=0.05, error_feedback=True)
+        g = jnp.concatenate([jnp.full((5,), 10.0), jnp.linspace(0.01, 0.1, 95)])
+        grads = {"w": jnp.tile(g[None, :], (8, 1))}
+        ef = {"w": jnp.zeros((100,), jnp.float32)}
+        out, ef1, _ = run_sync(mesh8, cfg, grads, ef=ef)
+        # small coords went to residual
+        assert float(jnp.sum(jnp.abs(ef1["w"]))) > 0
+        out2, ef2, _ = run_sync(mesh8, cfg, grads, ef=ef1, seed=1)
+        # residual keeps growing for untransmitted coords
+        assert float(jnp.max(ef2["w"])) >= float(jnp.max(ef1["w"]))

@@ -1,0 +1,154 @@
+"""Integration tests: the jitted shard_map train step on an 8-device CPU mesh.
+
+Covers the reference's hot loop semantics (`core.py:303-322`): forward,
+backward, compression comm, optimizer step — for dense and compressed DP,
+both granularities, with and without error feedback.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.models.common import init_model, make_apply_fn
+from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
+from tpu_compressed_dp.train.optim import SGD
+from tpu_compressed_dp.train.schedules import piecewise_linear
+from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.train.step import make_eval_step, make_train_step
+
+
+class TinyCNN(nn.Module):
+    """Small conv+BN net exercising batch_stats plumbing."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(8, (3, 3), use_bias=False, name="conv1")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, name="bn1")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(4, name="head")(x)
+        return x
+
+
+class TinyMLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(4)(x)
+
+
+def make_batch(n=64, seed=0, img=(8, 8, 3), classes=4):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, *img).astype(np.float32)
+    y = (x.reshape(n, -1).sum(axis=1) > 0).astype(np.int32) + 2 * (x[:, 0, 0, 0] > 0).astype(np.int32)
+    return {"input": jnp.asarray(x), "target": jnp.asarray(y % classes)}
+
+
+def build(mesh, module, cfg, *, bs=64, lr=0.05, momentum=0.9, ef=False):
+    params, stats = init_model(module, jax.random.key(0), jnp.zeros((1, 8, 8, 3), jnp.float32))
+    opt = SGD(lr=lr, momentum=momentum, nesterov=True, weight_decay=1e-4)
+    ef_state = init_ef_state(params, cfg, num_devices=mesh.shape["data"])
+    state = TrainState.create(params, stats, opt.init(params), ef_state, jax.random.key(1))
+    apply_fn = make_apply_fn(module)
+    step = make_train_step(apply_fn, opt, cfg, mesh, grad_scale=1.0, donate=False)
+    ev = make_eval_step(apply_fn, mesh)
+    return state, step, ev
+
+
+CONFIGS = [
+    CompressionConfig(method=None),
+    CompressionConfig(method="topk", ratio=0.25),
+    CompressionConfig(method="topk", ratio=0.25, granularity="entiremodel"),
+    CompressionConfig(method="randomk", ratio=0.5, error_feedback=True),
+    CompressionConfig(method="qsgd", qstates=255),
+    CompressionConfig(method="terngrad"),
+    CompressionConfig(method="adaptive_threshold", granularity="entiremodel"),
+    CompressionConfig(method="thresholdv", threshold=1e-4),
+]
+
+
+@pytest.mark.parametrize("cfg", CONFIGS, ids=lambda c: f"{c.method}-{c.granularity}-ef{c.error_feedback}")
+def test_loss_decreases(mesh8, cfg):
+    batch = make_batch()
+    state, step, _ = build(mesh8, TinyMLP(), cfg)
+    losses = []
+    for _ in range(30):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses[:3] + losses[-3:]
+    assert int(state.step) == 30
+
+
+def test_batchnorm_stats_update(mesh8):
+    batch = make_batch()
+    cfg = CompressionConfig(method=None)
+    state, step, _ = build(mesh8, TinyCNN(), cfg)
+    before = jax.tree.map(np.asarray, state.batch_stats)
+    state, _ = step(state, batch)
+    after = state.batch_stats
+    diff = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), before, after)
+    assert max(jax.tree.leaves(diff)) > 0
+
+
+def test_ef_state_threads_through(mesh8):
+    cfg = CompressionConfig(method="topk", ratio=0.1, error_feedback=True)
+    batch = make_batch()
+    state, step, _ = build(mesh8, TinyMLP(), cfg, ef=True)
+    state, _ = step(state, batch)
+    ef_mag = sum(float(jnp.sum(jnp.abs(l))) for l in jax.tree.leaves(state.ef))
+    assert ef_mag > 0
+
+
+def test_dense_equals_singlehost_sgd(mesh8):
+    """Dense DP over 8 devices == single-device SGD on the full batch."""
+    batch = make_batch(n=64)
+    cfg = CompressionConfig(method=None)
+    module = TinyMLP()
+    state, step, _ = build(mesh8, module, cfg, momentum=0.0)
+    params0 = state.params
+
+    # manual single-device reference step
+    from tpu_compressed_dp.train.step import cross_entropy_sum
+
+    apply_fn = make_apply_fn(module)
+
+    def loss_fn(p):
+        logits, _ = apply_fn(p, {}, batch["input"], True, {})
+        return cross_entropy_sum(logits, batch["target"]) / batch["input"].shape[0]
+
+    grads = jax.grad(loss_fn)(params0)
+    opt = SGD(lr=0.05, momentum=0.0, nesterov=True, weight_decay=1e-4)
+    expected, _ = opt.apply(params0, grads, opt.init(params0), jnp.asarray(1))
+
+    state, _ = step(state, batch)
+    for a, b in zip(jax.tree.leaves(expected), jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+
+
+def test_eval_step_counts(mesh8):
+    batch = make_batch(n=64)
+    state, step, ev = build(mesh8, TinyMLP(), CompressionConfig(method=None))
+    m = ev(state, batch)
+    assert float(m["count"]) == 64
+    assert 0 <= float(m["correct"]) <= 64
+    assert float(m["correct5"]) >= float(m["correct"])
+
+
+def test_lr_schedule_evaluated_per_step(mesh8):
+    batch = make_batch()
+    sched = piecewise_linear([0, 10, 20], [0.0, 1.0, 0.0])
+    module = TinyMLP()
+    params, stats = init_model(module, jax.random.key(0), jnp.zeros((1, 8, 8, 3), jnp.float32))
+    opt = SGD(lr=lambda s: sched(s / 10.0) * 0.01)
+    cfg = CompressionConfig(method=None)
+    state = TrainState.create(params, stats, opt.init(params), (), jax.random.key(1))
+    step = make_train_step(make_apply_fn(module), opt, cfg, mesh8, donate=False)
+    lrs = []
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        lrs.append(float(metrics["lr"]))
+    # schedule ramps linearly: lr at step s is s/100
+    np.testing.assert_allclose(lrs, [0.0001 * s for s in range(1, 6)], rtol=1e-4)

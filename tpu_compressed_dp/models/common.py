@@ -1,0 +1,43 @@
+"""Model adapter glue: flax modules -> the train step's ``apply_fn`` protocol.
+
+Every model in the zoo is a flax module whose ``__call__`` takes
+``(x, train: bool)``; this adapter normalises the batch_stats / dropout-rng
+plumbing so the train step (`train/step.py`) stays model-agnostic — the role
+the reference's dict-output ``Network`` interpreter played
+(`CIFAR10/torch_backend.py:107-118`), minus the graph walking.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_model", "make_apply_fn"]
+
+
+def init_model(module, rng: jax.Array, sample_input: jax.Array) -> Tuple[Any, Any]:
+    """Initialise a model; returns ``(params, batch_stats)`` (stats may be {})."""
+    variables = module.init({"params": rng, "dropout": rng}, sample_input, train=False)
+    return variables["params"], variables.get("batch_stats", {})
+
+
+def make_apply_fn(module):
+    """Build ``apply_fn(params, batch_stats, x, train, rngs) -> (logits, new_stats)``."""
+
+    def apply_fn(params, batch_stats, x, train: bool, rngs: Dict[str, jax.Array]):
+        variables = {"params": params}
+        has_stats = bool(batch_stats)
+        if has_stats:
+            variables["batch_stats"] = batch_stats
+        rngs = {k: v for k, v in rngs.items()} if train else {}
+        if train and has_stats:
+            logits, updates = module.apply(
+                variables, x, train=True, mutable=["batch_stats"], rngs=rngs
+            )
+            return logits, updates["batch_stats"]
+        logits = module.apply(variables, x, train=train, rngs=rngs)
+        return logits, batch_stats
+
+    return apply_fn

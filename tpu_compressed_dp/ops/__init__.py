@@ -1,0 +1,1 @@
+from tpu_compressed_dp.ops import compressors  # noqa: F401

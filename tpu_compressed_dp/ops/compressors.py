@@ -1,0 +1,234 @@
+"""Gradient compression operators (TPU-native, pure JAX).
+
+Re-implements the six compression methods of the reference harness
+(`/root/reference/CIFAR10/core.py:175-213`, duplicated at
+`IMAGENET/training/train_imagenet_nv.py:255-305`) as pure functions on flat
+gradient vectors.  Each operator maps ``(flat_grad, key) -> flat_compressed``
+where ``flat_compressed`` has the same shape as the input and zeros in the
+dropped positions.  This is the dense ("simulate") representation used by the
+paper's convergence-study protocol; the genuinely bandwidth-reducing packed
+representations live in :mod:`tpu_compressed_dp.ops.wire`.
+
+Design notes (TPU-first):
+  * Everything is shape-static: Top-K materialises a threshold via
+    ``jax.lax.top_k`` rather than a dynamically-sized index set, so the ops
+    compile cleanly under ``jit`` / ``shard_map``.
+  * Randomness is explicit (``jax.random`` keys) rather than global RNG state;
+    the caller decides whether keys are shared across data-parallel workers
+    (identical masks, as in the reference's shared-seed sparsified DDP,
+    `sparsified_ddp.py:164`) or per-worker (as in the CIFAR harness, which
+    never seeds and therefore draws independent masks per rank).
+  * Intended behaviour is implemented where the reference has defects
+    (SURVEY.md §2.3): division-by-zero in TernGrad / QSGD is guarded to
+    produce zeros instead of NaN/Inf (the reference maps Inf -> 0 for QSGD
+    only, `core.py:213`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = [
+    "CompressorFn",
+    "identity",
+    "top_k",
+    "random_k",
+    "threshold_v",
+    "adaptive_threshold",
+    "terngrad",
+    "random_dithering",
+    "get_compressor",
+    "REGISTRY",
+    "topk_keep_count",
+    "randomk_keep_count",
+]
+
+# A compressor maps a flat fp32 gradient and a PRNG key to a same-shaped
+# dense vector with zeros at dropped coordinates.
+CompressorFn = Callable[[Array, Array], Array]
+
+
+def _flat(g: Array) -> Array:
+    if g.ndim != 1:
+        raise ValueError(f"compressors operate on flat vectors, got shape {g.shape}")
+    return g
+
+
+def topk_keep_count(n: int, ratio: float) -> int:
+    """Number of elements Top-K keeps.
+
+    The reference thresholds at ``kthvalue(ceil(n*(1-K)))`` of ``|g|`` and keeps
+    elements ``>=`` that value (`core.py:181-183`), i.e. ``n - ceil(n*(1-K)) + 1``
+    elements (plus ties).  We reproduce that count exactly.
+    """
+    import math
+
+    m = max(1, math.ceil(n * (1.0 - ratio)))  # index (1-based) of the threshold
+    return max(1, n - m + 1)
+
+
+def randomk_keep_count(n: int, ratio: float) -> int:
+    """Number of elements Random-K keeps: ``ceil(n*ratio)``, clamped to ``[0, n]``.
+
+    The reference's ``randperm(n).lt(n*K)`` (`core.py:186`) keeps
+    ``ceil(n*K)`` elements for fractional ``n*K`` and exactly ``n*K`` when
+    integral; we compute the count statically (with a small epsilon absorbing
+    binary float dust in ``n*K``) so the mask has a trace-time-known size.
+    """
+    import math
+
+    return max(0, min(n, int(math.ceil(n * ratio - 1e-9))))
+
+
+def identity(g: Array, key: Optional[Array] = None) -> Array:
+    """No compression (the reference's dense fallback, `core.py:215`)."""
+    return _flat(g)
+
+
+def top_k(g: Array, key: Optional[Array] = None, *, ratio: float) -> Array:
+    """Keep the ``~ratio*n`` largest-magnitude coordinates (`core.py:178-183`).
+
+    Threshold semantics match the reference: the threshold is the
+    ``ceil(n*(1-ratio))``-th smallest ``|g|`` and everything ``>=`` it is kept,
+    so ties at the threshold are all kept.
+    """
+    g = _flat(g)
+    n = g.shape[0]
+    keep = topk_keep_count(n, ratio)
+    mag = jnp.abs(g)
+    # Threshold = smallest of the `keep` largest magnitudes.
+    thresh = jax.lax.top_k(mag, keep)[0][-1]
+    return jnp.where(mag >= thresh, g, 0.0)
+
+
+def random_k(g: Array, key: Array, *, ratio: float) -> Array:
+    """Keep a uniformly-random subset of ``~ratio*n`` coordinates (`core.py:184-188`).
+
+    The caller controls mask agreement across workers through the key: a
+    replicated key reproduces the shared-seed trick of the sparsified DDP
+    (`sparsified_ddp.py:164`); folding in the worker index reproduces the
+    unseeded per-rank masks of the CIFAR harness.
+    """
+    g = _flat(g)
+    n = g.shape[0]
+    perm = jax.random.permutation(key, n)
+    mask = perm < randomk_keep_count(n, ratio)
+    return jnp.where(mask, g, 0.0)
+
+
+def threshold_v(g: Array, key: Optional[Array] = None, *, threshold: float) -> Array:
+    """Keep coordinates with ``|g| >= V`` (`core.py:189-193`)."""
+    g = _flat(g)
+    return jnp.where(jnp.abs(g) >= threshold, g, 0.0)
+
+
+def adaptive_threshold(g: Array, key: Optional[Array] = None) -> Array:
+    """Keep coordinates with ``2|g| >= max|g|`` (`core.py:194-199`)."""
+    g = _flat(g)
+    gmax = jnp.max(jnp.abs(g))
+    return jnp.where(2.0 * jnp.abs(g) >= gmax, g, 0.0)
+
+
+def terngrad(g: Array, key: Array) -> Array:
+    """TernGrad ternarisation (`core.py:200-206`).
+
+    ``out_i = max|g| * sign(g_i) * Bernoulli(|g_i| / max|g|)`` — an unbiased
+    estimator of ``g``.  A zero gradient maps to zero (the reference would
+    produce NaN via 0/0; SURVEY.md §2.3 intended-behaviour rule).
+    """
+    g = _flat(g)
+    mag = jnp.abs(g)
+    gmax = jnp.max(mag)
+    prob = jnp.where(gmax > 0, mag / jnp.where(gmax > 0, gmax, 1.0), 0.0)
+    coin = jax.random.uniform(key, g.shape, dtype=g.dtype)
+    keep = (coin < prob).astype(g.dtype)
+    return jnp.sign(g) * gmax * keep
+
+
+def random_dithering(g: Array, key: Array, *, qstates: int = 255) -> Array:
+    """Random dithering / QSGD quantisation (`core.py:207-213`).
+
+    ``out_i = ||g||_2 * sign(g_i) * floor(|g_i|/||g|| * s + u_i) / s`` with
+    ``u_i ~ U[0,1)`` — unbiased stochastic rounding onto ``s`` levels.  The
+    reference maps Inf to 0 (`core.py:213`); we guard the zero-norm case the
+    same way.
+    """
+    g = _flat(g)
+    norm = jnp.linalg.norm(g)
+    safe_norm = jnp.where(norm > 0, norm, 1.0)
+    u = jax.random.uniform(key, g.shape, dtype=g.dtype)
+    levels = jnp.floor(jnp.abs(g) / safe_norm * qstates + u)
+    out = jnp.sign(g) * norm * levels / qstates
+    return jnp.where(norm > 0, out, jnp.zeros_like(g))
+
+
+@dataclasses.dataclass(frozen=True)
+class _Bound:
+    """A compressor with its hyper-parameters bound, keyed by canonical name."""
+
+    name: str
+    fn: CompressorFn
+    needs_rng: bool
+
+
+# Canonical names plus the reference CLI spellings (`dawn.py:16`,
+# `train_imagenet_nv.py`): Topk / Randomk / Thresholdv / AdaptiveThreshold /
+# TernGrad / RandomDithering.
+_ALIASES = {
+    "topk": "topk",
+    "randomk": "randomk",
+    "thresholdv": "thresholdv",
+    "adaptivethreshold": "adaptive_threshold",
+    "adaptive_threshold": "adaptive_threshold",
+    "terngrad": "terngrad",
+    "randomdithering": "qsgd",
+    "random_dithering": "qsgd",
+    "qsgd": "qsgd",
+    "none": "none",
+    "dense": "none",
+}
+
+REGISTRY = ("none", "topk", "randomk", "thresholdv", "adaptive_threshold", "terngrad", "qsgd")
+
+
+def get_compressor(
+    method: Optional[str],
+    *,
+    ratio: float = 0.5,
+    threshold: float = 1e-3,
+    qstates: int = 255,
+) -> _Bound:
+    """Resolve a method name (canonical or reference spelling) to a bound op.
+
+    Mirrors the dispatch in `core.py:178-215` — unknown methods fall through to
+    dense there; here they raise, since silent fallthrough hid the reference's
+    'enitremodel' bug (SURVEY.md §2.3).
+    """
+    if method is None:
+        method = "none"
+    canon = _ALIASES.get(method.lower().replace("-", "_"))
+    if canon is None:
+        raise ValueError(f"unknown compression method {method!r}; known: {REGISTRY}")
+    if canon == "none":
+        return _Bound("none", lambda g, key=None: identity(g), needs_rng=False)
+    if canon == "topk":
+        return _Bound("topk", lambda g, key=None: top_k(g, key, ratio=ratio), needs_rng=False)
+    if canon == "randomk":
+        return _Bound("randomk", lambda g, key: random_k(g, key, ratio=ratio), needs_rng=True)
+    if canon == "thresholdv":
+        return _Bound(
+            "thresholdv", lambda g, key=None: threshold_v(g, key, threshold=threshold), needs_rng=False
+        )
+    if canon == "adaptive_threshold":
+        return _Bound("adaptive_threshold", lambda g, key=None: adaptive_threshold(g), needs_rng=False)
+    if canon == "terngrad":
+        return _Bound("terngrad", terngrad, needs_rng=True)
+    if canon == "qsgd":
+        return _Bound("qsgd", lambda g, key: random_dithering(g, key, qstates=qstates), needs_rng=True)
+    raise AssertionError(canon)

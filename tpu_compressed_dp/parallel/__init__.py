@@ -1,0 +1,1 @@
+from tpu_compressed_dp.parallel import mesh, dp  # noqa: F401

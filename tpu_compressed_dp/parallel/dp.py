@@ -1,0 +1,196 @@
+"""Compressed data-parallel gradient synchronisation.
+
+TPU-native re-design of the reference's three DP flavours (SURVEY.md §2.2):
+
+  * dense per-layer allreduce loop            -> `method=None`
+  * ``layerwise_compressed_comm``             -> ``granularity='layerwise'``
+    (`CIFAR10/core.py:175-225`)
+  * ``entiremodel_compressed_comm``           -> ``granularity='entiremodel'``
+    (`CIFAR10/core.py:227-301`; the reference copy crashes if called —
+    SURVEY.md §2.3 — ours works)
+  * ``RandomKSparsifiedDDP`` error feedback   -> ``error_feedback=True``
+    (`IMAGENET/training/sparsified_ddp.py:222,408-413`)
+
+Instead of per-parameter autograd hooks driving NCCL buckets from C++
+(`ddp.py:394-409`), the whole pipeline — compress, reduce, average — is traced
+into the jitted train step under ``shard_map``; XLA's latency-hiding scheduler
+overlaps the psums with remaining backward compute, which is what the
+reference's reverse-order bucketing bought it by hand.
+
+Two payload modes (SURVEY.md §2.3 item 6):
+
+  * ``mode='simulate'`` — the paper's protocol: the compressed gradient is kept
+    dense (zeros at dropped coordinates) and allreduced full-size.  Studies
+    convergence, not bandwidth; bytes-on-wire are *accounted analytically*.
+  * ``mode='wire'`` — genuinely sparse payloads (packed k values; see
+    :mod:`tpu_compressed_dp.ops.wire`), the `RandomKSparsifiedDDP` equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from tpu_compressed_dp.ops import compressors
+
+__all__ = ["CompressionConfig", "make_grad_sync", "init_ef_state"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionConfig:
+    """Mirrors the reference CLI surface (`dawn.py:15-19`, `train_imagenet_nv.py`).
+
+    method:        none | topk | randomk | thresholdv | adaptive_threshold |
+                   terngrad | qsgd  (reference spellings accepted)
+    granularity:   'layerwise' (one op + one reduce per parameter tensor) or
+                   'entiremodel' (flatten the whole gradient, one op + reduce)
+    mode:          'simulate' (dense payload, paper protocol) or 'wire'
+                   (packed sparse payload)
+    ratio:         K for topk/randomk (`--ratio`, default 0.5)
+    threshold:     V for thresholdv (`--threshold`, default 1e-3)
+    qstates:       quantisation states for qsgd (`--qstates`, default 255)
+    error_feedback: keep the dropped residual and re-add next step
+                   (`sparsified_ddp.py:408-413`); the reference only has this
+                   in RandomKSparsifiedDDP — here it composes with any method.
+    shared_mask:   random masks identical across workers (shared-seed trick,
+                   `sparsified_ddp.py:164`).  Defaults: False for 'simulate'
+                   (the unseeded CIFAR harness draws per-rank masks), True is
+                   required for 'wire' randomk so indices line up.
+    """
+
+    method: Optional[str] = None
+    granularity: str = "layerwise"
+    mode: str = "simulate"
+    ratio: float = 0.5
+    threshold: float = 1e-3
+    qstates: int = 255
+    error_feedback: bool = False
+    shared_mask: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.granularity not in ("layerwise", "entiremodel"):
+            raise ValueError(f"granularity must be layerwise|entiremodel, got {self.granularity!r}")
+        if self.mode not in ("simulate", "wire"):
+            raise ValueError(f"mode must be simulate|wire, got {self.mode!r}")
+
+    @property
+    def resolved_shared_mask(self) -> bool:
+        if self.shared_mask is not None:
+            return self.shared_mask
+        return self.mode == "wire"
+
+
+def init_ef_state(grads_like: Any, cfg: CompressionConfig, num_devices: Optional[int] = None) -> Any:
+    """Zero error-feedback residual pytree (empty tuple when EF is off).
+
+    The residual is per-worker state (the reference keeps one ``epsilon`` per
+    rank, `sparsified_ddp.py:222-223`): pass ``num_devices`` to get leaves with
+    a leading device axis, to be sharded over the data mesh axis.  Unlike the
+    reference, this residual is part of the train state and hence checkpointed
+    (SURVEY.md §5 checkpoint gap).
+    """
+    if not cfg.error_feedback:
+        return ()
+    if num_devices is None:
+        return jax.tree.map(jnp.zeros_like, grads_like)
+    return jax.tree.map(
+        lambda g: jnp.zeros((num_devices,) + g.shape, dtype=jnp.float32), grads_like
+    )
+
+
+def _leaf_key(key: jax.Array, index: int, per_worker: bool, axis_name: str) -> jax.Array:
+    k = jax.random.fold_in(key, index)
+    if per_worker:
+        k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+    return k
+
+
+def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
+    """Build ``sync(grads, ef, key) -> (synced_grads, new_ef, comm_stats)``.
+
+    Must be called *inside* ``shard_map`` (uses ``lax.psum`` / ``axis_index``
+    over ``axis_name``).  ``grads`` are the local worker's gradients at the
+    same scale the reference compresses (see train/step.py); the return value
+    is the world-averaged gradient, matching `core.py:217-222`.
+
+    ``comm_stats`` reports per-step communication analytically (SURVEY.md §5:
+    the reference measured NIC bytes via /proc/net/dev; on TPU the payload is
+    known at trace time for fixed-k methods and counted at run time for
+    threshold methods): ``sent_elems`` is what the wire representation would
+    carry, ``dense_elems`` the uncompressed size.
+    """
+    if cfg.mode == "wire":
+        try:
+            from tpu_compressed_dp.ops import wire  # deferred: optional fast path
+        except ImportError as e:
+            raise NotImplementedError(
+                "mode='wire' requires tpu_compressed_dp.ops.wire, which is not "
+                "available in this build; use mode='simulate'"
+            ) from e
+        return wire.make_wire_grad_sync(cfg, axis_name)
+
+    comp = compressors.get_compressor(
+        cfg.method, ratio=cfg.ratio, threshold=cfg.threshold, qstates=cfg.qstates
+    )
+    per_worker_rng = not cfg.resolved_shared_mask
+
+    def compress_flat(flat: jax.Array, key: jax.Array, index: int) -> jax.Array:
+        k = _leaf_key(key, index, per_worker_rng and comp.needs_rng, axis_name)
+        return comp.fn(flat, k)
+
+    def sync(grads: Any, ef: Any, key: jax.Array) -> Tuple[Any, Any, Dict[str, jax.Array]]:
+        world = jax.lax.psum(1, axis_name)
+        leaves, treedef = jax.tree.flatten(grads)
+        use_ef = cfg.error_feedback
+        ef_leaves = jax.tree.leaves(ef) if use_ef else [None] * len(leaves)
+
+        if cfg.granularity == "entiremodel":
+            flat, unravel = ravel_pytree(grads)
+            if use_ef:
+                ef_flat, _ = ravel_pytree(ef)
+                acc = flat + ef_flat
+            else:
+                acc = flat
+            comp_flat = compress_flat(acc, key, 0)
+            new_ef_flat = acc - comp_flat
+            reduced = jax.lax.psum(comp_flat, axis_name) / world
+            sent = jnp.count_nonzero(comp_flat)
+            out = unravel(reduced)
+            new_ef = unravel(new_ef_flat) if use_ef else ()
+            stats = {
+                "sent_elems": sent.astype(jnp.float32),
+                "dense_elems": jnp.asarray(float(flat.shape[0]), jnp.float32),
+                "num_collectives": jnp.asarray(1.0, jnp.float32),
+            }
+            return out, new_ef, stats
+
+        # layerwise: one operator application (and, conceptually, one
+        # collective) per parameter tensor — `core.py:176`.  The per-leaf
+        # psums are left unfused; XLA coalesces/schedules them.
+        out_leaves, new_ef_leaves, sent_total = [], [], jnp.asarray(0.0, jnp.float32)
+        dense_total = 0.0
+        for i, (g, e) in enumerate(zip(leaves, ef_leaves)):
+            flat = g.reshape(-1)
+            acc = flat + e.reshape(-1) if use_ef else flat
+            comp_flat = compress_flat(acc, key, i)
+            if use_ef:
+                new_ef_leaves.append((acc - comp_flat).reshape(g.shape))
+            reduced = jax.lax.psum(comp_flat, axis_name) / world
+            out_leaves.append(reduced.reshape(g.shape))
+            sent_total = sent_total + jnp.count_nonzero(comp_flat).astype(jnp.float32)
+            dense_total += float(flat.shape[0])
+
+        out = jax.tree.unflatten(treedef, out_leaves)
+        new_ef = jax.tree.unflatten(treedef, new_ef_leaves) if use_ef else ()
+        stats = {
+            "sent_elems": sent_total,
+            "dense_elems": jnp.asarray(dense_total, jnp.float32),
+            "num_collectives": jnp.asarray(float(len(leaves)), jnp.float32),
+        }
+        return out, new_ef, stats
+
+    return sync

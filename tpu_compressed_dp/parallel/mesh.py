@@ -1,0 +1,103 @@
+"""Device mesh construction and multi-host rendezvous.
+
+TPU-native replacement for the reference's process-group machinery:
+``dist.init_process_group('gloo'|'nccl', ...)`` (`CIFAR10/core.py:334`,
+`IMAGENET/training/train_imagenet_nv.py:161-162`) and the NCCL ring-order
+tuning strings (`IMAGENET/train.py:159-203`).  On TPU there is no user-level
+ring configuration: we build a `jax.sharding.Mesh` and let XLA route
+collectives over ICI/DCN; the mesh axis layout *is* the tuning surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "distributed_init",
+    "make_data_mesh",
+    "make_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "world_size",
+    "force_host_devices",
+]
+
+DATA_AXIS = "data"
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host rendezvous.
+
+    Equivalent of the reference's ``env://`` NCCL rendezvous driven by
+    ``MASTER_ADDR``/``RANK``/``WORLD_SIZE`` (`train_imagenet_nv.py:64-66`,
+    `dist_utils.py:27-28`).  On Cloud TPU the arguments are auto-detected; on
+    other platforms they map 1:1 onto the reference's flags
+    (``--master_address``, ``--world_size``, ``--rank``, `dawn.py:11-13`).
+    No-ops when running single-process.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    if coordinator_address is None and num_processes is None and "COORDINATOR_ADDRESS" not in os.environ:
+        # Single-process (possibly multi-chip) run: nothing to rendezvous.
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def force_host_devices(n: int) -> None:
+    """Emulate an ``n``-chip mesh on CPU (the JAX-native multi-device fake).
+
+    Must run before the first JAX backend initialisation.  This is the test
+    fixture the reference lacked (SURVEY.md §4): its closest analog was N
+    Gloo processes on one machine.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def make_data_mesh(num_devices: Optional[int] = None, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-D ``('data',)`` mesh — the data-parallel world.
+
+    The reference's world is the flat rank set of the process group; here it is
+    a named mesh axis so the compression layer can later compose with model
+    axes (tensor/pipeline/sequence) without rework (SURVEY.md §2.2).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str]) -> Mesh:
+    """General N-D mesh for composed parallelism (dp x tp x pp x sp ...)."""
+    n = int(np.prod(axis_sizes))
+    devices = np.asarray(jax.devices()[:n]).reshape(tuple(axis_sizes))
+    return Mesh(devices, tuple(axis_names))
+
+
+def world_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    return mesh.shape[axis]
+
+
+def data_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Batch-dimension sharding over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,1 @@
+from tpu_compressed_dp.train import optim, schedules, state, step  # noqa: F401

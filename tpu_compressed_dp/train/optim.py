@@ -1,0 +1,114 @@
+"""SGD with schedule-valued hyper-parameters, as pure JAX.
+
+Functional re-design of the reference's ``TorchOptimiser``/``SGD`` pair
+(`CIFAR10/torch_backend.py:122-143`): hyper-parameters may be callables of the
+step number, re-evaluated every step inside the jitted train step (the
+reference re-evaluated them in Python and poked ``param_groups``).  Update
+rule matches ``torch.optim.SGD`` (including Nesterov), which is what both
+reference harnesses use (`dawn.py:146-148`, `train_imagenet_nv.py:185-191`).
+
+Also provides the BatchNorm weight-decay exclusion of
+`IMAGENET/training/experimental_utils.py:3-22` (``--no-bn-wd``) as a pytree
+mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Schedule = Callable[[Array], Array]
+ScalarOrSchedule = Union[float, Schedule]
+
+__all__ = ["SGD", "bn_wd_mask"]
+
+
+def _value(v: ScalarOrSchedule, step: Array) -> Array:
+    """Evaluate a hyper-parameter: callable-of-step or constant.
+
+    Mirrors ``TorchOptimiser.param_values`` (`torch_backend.py:129-130`).
+    """
+    return v(step) if callable(v) else jnp.asarray(v, jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class SGD:
+    """torch-semantics SGD: ``d = g + wd*p; buf = mu*buf + (1-damp)*d;
+    d = d + mu*buf if nesterov; p -= lr*d``.
+
+    ``wd_mask`` is a pytree of bools (or a predicate applied via
+    ``bn_wd_mask``) selecting which params receive weight decay.
+    """
+
+    lr: ScalarOrSchedule = 0.0
+    momentum: ScalarOrSchedule = 0.0
+    weight_decay: ScalarOrSchedule = 0.0
+    dampening: float = 0.0
+    nesterov: bool = False
+    wd_mask: Optional[Any] = None
+
+    def init(self, params: Any) -> Any:
+        """Momentum buffers, zero-initialised.
+
+        torch seeds the buffer with the first gradient rather than
+        ``mu*0 + (1-damp)*g``; with ``dampening=0`` (the only value the
+        reference uses) zero-init is identical from step one onward.
+        """
+        return {"momentum": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, params: Any, grads: Any, opt_state: Any, step: Array):
+        lr = _value(self.lr, step)
+        mu = _value(self.momentum, step)
+        wd = _value(self.weight_decay, step)
+        mask = self.wd_mask if self.wd_mask is not None else jax.tree.map(lambda _: True, params)
+
+        def upd(p, g, buf, use_wd):
+            g = g.astype(jnp.float32)
+            d = g + wd * p if use_wd else g
+            buf = mu * buf + (1.0 - self.dampening) * d
+            d = d + mu * buf if self.nesterov else buf
+            return p - lr * d, buf
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = jax.tree.leaves(grads)
+        flat_b = jax.tree.leaves(opt_state["momentum"])
+        flat_m = jax.tree.leaves(mask)
+        new_p, new_b = [], []
+        for p, g, b, m in zip(flat_p, flat_g, flat_b, flat_m):
+            np_, nb = upd(p, g, b, bool(m))
+            new_p.append(np_)
+            new_b.append(nb)
+        return (
+            jax.tree.unflatten(treedef, new_p),
+            {"momentum": jax.tree.unflatten(treedef, new_b)},
+        )
+
+
+def bn_wd_mask(params: Any, is_excluded: Optional[Callable[[tuple], bool]] = None) -> Any:
+    """True where weight decay applies; False for BatchNorm params.
+
+    Equivalent of ``bnwd_optim_params``/``split_bn_params``
+    (`experimental_utils.py:5-22`), which exclude all parameters belonging to
+    BatchNorm modules.  By default a leaf is excluded when any path component
+    mentions batch-norm (flax modules named ``bn*`` / ``BatchNorm*``).
+    """
+
+    def default_excluded(path: tuple) -> bool:
+        return any(("bn" in str(k).lower() or "batchnorm" in str(k).lower()) for k in path)
+
+    pred = is_excluded or default_excluded
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree.structure(params)
+    vals = [not pred(tuple(_key_str(k) for k in path)) for path, _ in flat]
+    return jax.tree.unflatten(treedef, vals)
+
+
+def _key_str(k) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)

@@ -1,0 +1,80 @@
+"""Learning-rate schedules as pure functions of the step.
+
+Covers both reference schedule systems:
+  * ``PiecewiseLinear`` (`CIFAR10/core.py:157-159`) — np.interp over knots.
+  * The ImageNet phase mini-DSL (`train_imagenet_nv.py:602-651`): a list of
+    ``{'ep': e | (e0, e1), 'lr': v | (v0, v1)}`` dicts, constant or linearly
+    interpolated within a phase, at per-batch granularity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+Schedule = Callable[[Array], Array]
+
+__all__ = ["piecewise_linear", "phase_lr_schedule", "lr_phases_to_knots"]
+
+
+def piecewise_linear(knots: Sequence[float], vals: Sequence[float]) -> Schedule:
+    """``PiecewiseLinear(knots, vals)(t)`` = linear interpolation, clamped at the ends.
+
+    ``t`` is in whatever unit the caller chooses (the CIFAR harness uses
+    fractional epochs: ``step / batches_per_epoch``, `dawn.py:142`).
+    """
+    kn = jnp.asarray(knots, jnp.float32)
+    vs = jnp.asarray(vals, jnp.float32)
+
+    def schedule(t: Array) -> Array:
+        return jnp.interp(jnp.asarray(t, jnp.float32), kn, vs)
+
+    return schedule
+
+
+def lr_phases_to_knots(phases: List[dict]) -> Tuple[List[float], List[float]]:
+    """Flatten ImageNet-style lr phases into (knots, vals) for interpolation.
+
+    A phase ``{'ep': (e0, e1), 'lr': (v0, v1)}`` ramps linearly; ``'ep': e``
+    with scalar ``lr`` holds the value until the next phase starts
+    (`train_imagenet_nv.py:611-634` semantics).
+    """
+    knots: List[float] = []
+    vals: List[float] = []
+    lr_phases = [p for p in phases if "lr" in p]
+    for i, p in enumerate(lr_phases):
+        ep = p["ep"]
+        lr = p["lr"]
+        if isinstance(ep, (tuple, list)):
+            e0, e1 = float(ep[0]), float(ep[1])
+        else:
+            e0 = float(ep)
+            if i + 1 < len(lr_phases):
+                nxt = lr_phases[i + 1]["ep"]
+                e1 = float(nxt[0] if isinstance(nxt, (tuple, list)) else nxt)
+            else:
+                e1 = e0 + 1.0
+        if isinstance(lr, (tuple, list)):
+            v0, v1 = float(lr[0]), float(lr[1])
+        else:
+            v0 = v1 = float(lr)
+        # Nudge the start knot so back-to-back phases don't share an x value
+        # (np.interp would otherwise pick an arbitrary side of the jump).
+        if knots and e0 <= knots[-1]:
+            e0 = knots[-1] + 1e-6
+        knots += [e0, e1]
+        vals += [v0, v1]
+    return knots, vals
+
+
+def phase_lr_schedule(phases: List[dict], batches_per_epoch: int) -> Schedule:
+    """Per-batch LR from ImageNet phase dicts; input is the global step."""
+    knots, vals = lr_phases_to_knots(phases)
+    base = piecewise_linear(knots, vals)
+
+    def schedule(step: Array) -> Array:
+        return base(jnp.asarray(step, jnp.float32) / float(batches_per_epoch))
+
+    return schedule

@@ -1,0 +1,38 @@
+"""Train state: the one pytree that is stepped, replicated, and checkpointed.
+
+Unlike the reference — where momentum lives in torch.optim, BN stats inside
+modules, and the error-feedback residual in a wrapper that is *not*
+checkpointed (SURVEY.md §5) — everything mutable is explicit here and goes
+through Orbax as a unit: ``{step, params, batch_stats, opt_state, ef, rng}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+__all__ = ["TrainState"]
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array            # int32 global step counter
+    params: Any                # model parameters (fp32 master copy)
+    batch_stats: Any           # BatchNorm running stats ({} for stat-free models)
+    opt_state: Any             # optimizer buffers (momentum, ...)
+    ef: Any                    # error-feedback residual pytree, or () when off
+    rng: jax.Array             # base PRNG key; per-step keys are folded from it
+
+    @classmethod
+    def create(cls, params: Any, batch_stats: Any, opt_state: Any, ef: Any, rng: jax.Array):
+        return cls(
+            step=jnp.asarray(0, jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+            ef=ef,
+            rng=rng,
+        )
