@@ -1,0 +1,64 @@
+"""End-to-end smoke tests of the dawn harness (`--short-epoch` analog,
+SURVEY.md §4): synthetic data, few epochs, assert learning happens."""
+
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.harness import dawn
+
+
+def run_dawn(tmp_path, **overrides):
+    # narrow net + tiny synthetic set: CPU-mesh smoke budget (the real
+    # protocol runs on TPU via this same code path)
+    argv = ["--synthetic", "--synthetic_n", "512", "--channels_scale", "0.125",
+            "--log_dir", str(tmp_path), "--batch_size", "64", "--devices", "8"]
+    for k, v in overrides.items():
+        argv += [f"--{k}"] + ([] if v is True else [str(v)])
+    args = dawn.build_parser().parse_args(argv)
+    return dawn.run(args)
+
+
+def test_dense_resnet9_learns(tmp_path, mesh8):
+    summary = run_dawn(tmp_path, epochs=3, momentum=0.9)
+    assert summary["epoch"] == 3
+    assert summary["train acc"] > 0.5  # synthetic blobs are easy; chance = 0.1
+    assert (tmp_path / "logs.tsv").exists()
+    tsv = (tmp_path / "logs.tsv").read_text().splitlines()
+    assert tsv[0] == "epoch\thours\ttop1Accuracy"
+    assert len(tsv) == 4
+
+
+def test_compressed_topk_layerwise_learns(tmp_path, mesh8):
+    summary = run_dawn(
+        tmp_path, epochs=3, compress="layerwise", method="Topk", ratio=0.1,
+        error_feedback=True, momentum=0.9,
+    )
+    assert summary["train acc"] > 0.5
+    assert 0.0 < summary["sent frac"] < 0.2  # ~10% of elements sent
+
+
+def test_compressed_entiremodel_qsgd(tmp_path, mesh8):
+    summary = run_dawn(
+        tmp_path, epochs=2, compress="entiremodel", method="RandomDithering", qstates=255,
+        momentum=0.9,
+    )
+    assert summary["train acc"] > 0.3
+
+
+def test_epochs_rule():
+    assert dawn.default_epochs("Randomk") == 40
+    assert dawn.default_epochs("Thresholdv") == 40
+    assert dawn.default_epochs("Topk") == 24
+    assert dawn.default_epochs("none") == 24
+
+
+def test_batch_size_must_divide_mesh(tmp_path):
+    with pytest.raises(ValueError, match="divisible"):
+        run_dawn(tmp_path, epochs=1, batch_size=100)
+
+
+def test_real_data_missing_gives_clear_error(tmp_path):
+    argv = ["--data_dir", str(tmp_path / "nope"), "--epochs", "1"]
+    args = dawn.build_parser().parse_args(argv)
+    with pytest.raises(FileNotFoundError, match="synthetic_cifar10"):
+        dawn.run(args)

@@ -1,0 +1,102 @@
+"""Model zoo shape/param tests (architecture parity with the reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.models import alexnet, resnet, resnet9, vgg
+from tpu_compressed_dp.models.common import init_model, make_apply_fn
+
+
+def n_params(tree):
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+@pytest.mark.parametrize(
+    "module,img,ncls",
+    [
+        (resnet9.ResNet9(), 32, 10),
+        (resnet9.AlexNetGraph(), 32, 10),
+        (alexnet.AlexNet(), 32, 10),
+        (vgg.vgg16(), 32, 10),
+    ],
+    ids=["resnet9", "alexnet_graph", "alexnet_module", "vgg16"],
+)
+def test_cifar_models_forward(module, img, ncls):
+    params, stats = init_model(module, jax.random.key(0), jnp.zeros((1, img, img, 3)))
+    apply_fn = make_apply_fn(module)
+    x = jax.random.normal(jax.random.key(1), (4, img, img, 3))
+    logits, _ = apply_fn(params, stats, x, False, {})
+    assert logits.shape == (4, ncls)
+    logits_t, new_stats = apply_fn(params, stats, x, True, {"dropout": jax.random.key(2)})
+    assert logits_t.shape == (4, ncls)
+
+
+def test_resnet9_param_count():
+    """DAWNBench ResNet-9 has ~6.57M params (reference architecture)."""
+    params, _ = init_model(resnet9.ResNet9(), jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    n = n_params(params)
+    assert 6.4e6 < n < 6.8e6, n
+
+
+def test_vgg16_matches_torchvision_param_count():
+    """VGG-16 (no BN), 10 classes, 7x7 adaptive pool: same layer dims as
+    torchvision => 134.3M params (1000-class version also checked)."""
+    params, _ = init_model(vgg.vgg16(), jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    n = n_params(params)
+    # torchvision vgg16 w/ 1000 classes = 138_357_544; with 10 classes:
+    expected = 138_357_544 - (4096 * 1000 + 1000) + (4096 * 10 + 10)
+    assert n == expected, (n, expected)
+
+
+def test_resnet50_param_count():
+    params, _ = init_model(
+        resnet.resnet50(num_classes=1000), jax.random.key(0), jnp.zeros((1, 64, 64, 3))
+    )
+    n = n_params(params)
+    assert n == 25_557_032, n  # torchvision resnet50 reference count
+
+
+def test_resnet50_bn0_init():
+    """--init-bn0: last BN gamma of every block zero (`resnet.py:154-160`)."""
+    params, _ = init_model(
+        resnet.resnet50(num_classes=10, bn0=True), jax.random.key(0), jnp.zeros((1, 64, 64, 3))
+    )
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    zeroed = [
+        p for path, p in flat
+        if any(getattr(k, "key", "") == "bn3" for k in path)
+        and any(getattr(k, "key", "") == "scale" for k in path)
+    ]
+    assert len(zeroed) == 16  # 3+4+6+3 bottleneck blocks
+    for z in zeroed:
+        np.testing.assert_allclose(np.asarray(z), 0.0)
+
+
+def test_resnet50_forward_shape():
+    m = resnet.resnet50(num_classes=7)
+    params, stats = init_model(m, jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    logits, _ = make_apply_fn(m)(params, stats, jnp.zeros((2, 64, 64, 3)), False, {})
+    assert logits.shape == (2, 7)
+
+
+def test_adaptive_avg_pool_torch_semantics():
+    # tiling when input < output (1x1 -> 7x7) and identity at equal size
+    x = jnp.arange(4.0).reshape(1, 1, 1, 4)
+    out = vgg.adaptive_avg_pool(x, 7)
+    assert out.shape == (1, 7, 7, 4)
+    np.testing.assert_allclose(np.asarray(out[0, 3, 3]), np.arange(4.0))
+    x2 = jax.random.normal(jax.random.key(0), (2, 7, 7, 3))
+    np.testing.assert_allclose(np.asarray(vgg.adaptive_avg_pool(x2, 7)), np.asarray(x2), rtol=1e-6)
+
+
+def test_resnet9_classifier_scale():
+    """Logits are scaled by 0.125 (`Mul(weight)`, `dawn.py:54,70`)."""
+    m1 = resnet9.ResNet9(classifier_weight=0.125)
+    m2 = resnet9.ResNet9(classifier_weight=1.0)
+    params, stats = init_model(m1, jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    l1, _ = make_apply_fn(m1)(params, stats, x, False, {})
+    l2, _ = make_apply_fn(m2)(params, stats, x, False, {})
+    np.testing.assert_allclose(np.asarray(l1) * 8.0, np.asarray(l2), rtol=1e-5)

@@ -137,6 +137,37 @@ def test_eval_step_counts(mesh8):
     assert float(m["correct5"]) >= float(m["correct"])
 
 
+def test_eval_mask_excludes_padding(mesh8):
+    """Padded examples (mask 0, label -1) contribute to no metric."""
+    import jax.numpy as jnp
+
+    state, _, ev = build(mesh8, TinyMLP(), CompressionConfig(method=None))
+    real = make_batch(n=40)
+    padded = {
+        "input": jnp.concatenate([real["input"], jnp.zeros((24, 8, 8, 3))]),
+        "target": jnp.concatenate([real["target"], jnp.full((24,), -1, jnp.int32)]),
+        "mask": jnp.concatenate([jnp.ones((40,)), jnp.zeros((24,))]),
+    }
+    m_pad = ev(state, padded)
+    assert float(m_pad["count"]) == 40
+    # metrics equal a direct (unsharded) computation over the 40 real examples
+    from tpu_compressed_dp.train.step import cross_entropy_per_example
+    from tpu_compressed_dp.models.common import make_apply_fn
+
+    logits, _ = make_apply_fn(TinyMLP())(state.params, {}, real["input"], False, {})
+    np.testing.assert_allclose(
+        float(m_pad["loss_sum"]),
+        float(jnp.sum(cross_entropy_per_example(logits, real["target"]))),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(m_pad["correct"]),
+        float(jnp.sum(jnp.argmax(logits, axis=1) == real["target"])),
+    )
+    # out-of-range padded labels also produce finite loss contributions (0)
+    assert np.isfinite(float(m_pad["loss_sum"]))
+
+
 def test_lr_schedule_evaluated_per_step(mesh8):
     batch = make_batch()
     sched = piecewise_linear([0, 10, 20], [0.0, 1.0, 0.0])

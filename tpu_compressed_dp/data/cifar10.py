@@ -1,0 +1,160 @@
+"""CIFAR-10 in-RAM pipeline: load, preprocess, augment, batch.
+
+Re-implements the reference's numpy-side preprocessing
+(`CIFAR10/core.py:43-56`: normalise / reflect-pad 4) and per-epoch-sampled
+augmentation (`core.py:62-114`: Crop(32,32), FlipLR, Cutout(8,8) with random
+choices drawn once per epoch in ``Transform.set_random_choices``) — but
+vectorised over the whole epoch instead of per-sample ``__getitem__``, and in
+NHWC (TPU-native) instead of the reference's NCHW ``transpose``
+(`core.py:55-56`).
+
+Loading uses torchvision files when present (`torch_backend.py:36-42`); a
+deterministic synthetic fallback keeps tests and zero-egress environments
+working (the reference had no offline story).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CIFAR10_MEAN",
+    "CIFAR10_STD",
+    "load_cifar10",
+    "synthetic_cifar10",
+    "normalise",
+    "pad",
+    "augment_epoch",
+    "Batches",
+]
+
+CIFAR10_MEAN = (0.4914, 0.4822, 0.4465)  # core.py:43
+CIFAR10_STD = (0.2471, 0.2435, 0.2616)  # core.py:44
+
+
+def normalise(x: np.ndarray, mean=CIFAR10_MEAN, std=CIFAR10_STD) -> np.ndarray:
+    """(x - 255*mean) / (255*std) on uint8 NHWC input (`core.py:46-50`)."""
+    x = np.asarray(x, np.float32)
+    x -= np.asarray(mean, np.float32) * 255.0
+    x *= 1.0 / (255.0 * np.asarray(std, np.float32))
+    return x
+
+
+def pad(x: np.ndarray, border: int = 4) -> np.ndarray:
+    """Reflect-pad H and W of NHWC (`core.py:52-53`)."""
+    return np.pad(x, [(0, 0), (border, border), (border, border), (0, 0)], mode="reflect")
+
+
+def load_cifar10(data_dir: str) -> Dict[str, Dict[str, np.ndarray]]:
+    """Raw uint8 NHWC CIFAR-10 from torchvision files (`torch_backend.py:36-42`).
+
+    Raises FileNotFoundError (with a pointer to ``synthetic_cifar10``) when the
+    dataset is absent and cannot be downloaded.
+    """
+    try:
+        import torchvision
+
+        train = torchvision.datasets.CIFAR10(root=data_dir, train=True, download=False)
+        test = torchvision.datasets.CIFAR10(root=data_dir, train=False, download=False)
+    except (ImportError, RuntimeError) as e:
+        raise FileNotFoundError(
+            f"CIFAR-10 not found under {data_dir!r} ({e}); download it there or use "
+            "synthetic_cifar10() for smoke runs"
+        ) from e
+    return {
+        "train": {"data": np.asarray(train.data), "labels": np.asarray(train.targets, np.int32)},
+        "test": {"data": np.asarray(test.data), "labels": np.asarray(test.targets, np.int32)},
+    }
+
+
+def synthetic_cifar10(
+    n_train: int = 2048, n_test: int = 512, num_classes: int = 10, seed: int = 0
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Deterministic learnable stand-in: class-dependent colour blobs + noise."""
+    rng = np.random.RandomState(seed)
+
+    def make(n):
+        labels = rng.randint(0, num_classes, n).astype(np.int32)
+        protos = np.random.RandomState(1234).randint(0, 255, (num_classes, 4, 4, 3))
+        imgs = protos[labels]
+        imgs = np.repeat(np.repeat(imgs, 8, axis=1), 8, axis=2).astype(np.float32)
+        imgs += rng.randn(n, 32, 32, 3) * 25.0
+        return {"data": np.clip(imgs, 0, 255).astype(np.uint8), "labels": labels}
+
+    return {"train": make(n_train), "test": make(n_test)}
+
+
+def augment_epoch(
+    x: np.ndarray,
+    rng: np.random.RandomState,
+    crop: Tuple[int, int] = (32, 32),
+    cutout: Optional[Tuple[int, int]] = (8, 8),
+    flip: bool = True,
+) -> np.ndarray:
+    """One epoch's worth of Crop + FlipLR + Cutout, choices pre-sampled per
+    sample exactly like ``Transform.set_random_choices`` (`core.py:107-114`),
+    applied vectorised.  ``x`` is padded NHWC float32."""
+    n, h, w, c = x.shape
+    ch, cw = crop
+    y0 = rng.randint(0, h - ch + 1, n)
+    x0 = rng.randint(0, w - cw + 1, n)
+    windows = np.lib.stride_tricks.sliding_window_view(x, (ch, cw), axis=(1, 2))
+    out = windows[np.arange(n), y0, x0]  # (N, C, ch, cw)
+    out = np.ascontiguousarray(out.transpose(0, 2, 3, 1))  # back to NHWC
+
+    if flip:
+        f = rng.rand(n) < 0.5
+        out[f] = out[f, :, ::-1, :]
+
+    if cutout is not None:
+        kh, kw = cutout
+        cy = rng.randint(0, ch - kh + 1, n)
+        cx = rng.randint(0, cw - kw + 1, n)
+        rows = np.arange(ch)[None, :]
+        cols = np.arange(cw)[None, :]
+        rmask = (rows >= cy[:, None]) & (rows < (cy + kh)[:, None])  # (N, H)
+        cmask = (cols >= cx[:, None]) & (cols < (cx + kw)[:, None])  # (N, W)
+        mask = rmask[:, :, None] & cmask[:, None, :]  # (N, H, W)
+        out *= ~mask[..., None]
+    return out
+
+
+class Batches:
+    """Epoch iterator yielding ``{'input', 'target'}`` numpy batches
+    (`torch_backend.py:48-63` equivalent; augmentation happens per epoch when
+    ``augment=True``, mirroring ``set_random_choices=True``)."""
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        *,
+        shuffle: bool,
+        augment: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+    ):
+        self.data = data
+        self.labels = np.asarray(labels, np.int32)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.augment = augment
+        self.drop_last = drop_last
+        self.rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        n = len(self.labels)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        n = len(self.labels)
+        x = augment_epoch(self.data, self.rng) if self.augment else self.data
+        idx = self.rng.permutation(n) if self.shuffle else np.arange(n)
+        stop = len(self) * self.batch_size if self.drop_last else n
+        for lo in range(0, stop, self.batch_size):
+            sel = idx[lo : lo + self.batch_size]
+            yield {"input": x[sel], "target": self.labels[sel]}

@@ -1,0 +1,92 @@
+"""Epoch-level training loop shared by the CIFAR and ImageNet harnesses.
+
+The framework equivalent of ``run_batches`` / ``train_epoch`` / ``train``
+(`CIFAR10/core.py:303-341`): the per-batch body is entirely inside the jitted
+train step, so the host loop only feeds batches and accumulates the already
+globally-reduced metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.utils.loggers import MetricAccumulator
+from tpu_compressed_dp.utils.timer import Timer
+
+__all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch"]
+
+
+def pad_batch(batch: Dict[str, np.ndarray], size: int) -> Dict[str, np.ndarray]:
+    """Pad a (possibly short) final batch to a static ``size`` with a 0/1 mask,
+    so every eval step sees one shape (no per-shape recompiles)."""
+    n = len(batch["target"])
+    mask = np.zeros((size,), np.float32)
+    mask[:n] = 1.0
+    if n == size:
+        return {**batch, "mask": mask}
+    pad_n = size - n
+    x = np.concatenate([batch["input"], np.zeros((pad_n,) + batch["input"].shape[1:],
+                                                 batch["input"].dtype)])
+    y = np.concatenate([batch["target"], np.full((pad_n,), -1, batch["target"].dtype)])
+    return {"input": x, "target": y, "mask": mask}
+
+
+def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict]) -> Tuple[TrainState, MetricAccumulator]:
+    acc = MetricAccumulator()
+    for batch in batches:
+        state, metrics = train_step(state, {k: jnp.asarray(v) for k, v in batch.items()})
+        acc.update(metrics)
+    return state, acc
+
+
+def run_eval(eval_step, state: TrainState, batches: Iterable[Dict], batch_size: int) -> Dict[str, float]:
+    sums = {"loss_sum": 0.0, "correct": 0.0, "correct5": 0.0, "count": 0.0}
+    for batch in batches:
+        padded = pad_batch(batch, batch_size)
+        m = eval_step(state, {k: jnp.asarray(v) for k, v in padded.items()})
+        for k in sums:
+            sums[k] += float(m[k])
+    n = max(sums["count"], 1.0)
+    return {
+        "loss": sums["loss_sum"] / n,
+        "acc": sums["correct"] / n,
+        "acc5": sums["correct5"] / n,
+        "count": sums["count"],
+    }
+
+
+def train_epoch(
+    train_step,
+    eval_step,
+    state: TrainState,
+    train_batches,
+    test_batches,
+    timer: Timer,
+    batch_size: int,
+    test_time_in_total: bool = False,
+) -> Tuple[TrainState, Dict[str, float]]:
+    """One train + eval pass with the reference's epoch-summary shape
+    (`core.py:324-331`)."""
+    state, train_acc = run_train_epoch(train_step, state, train_batches)
+    train_time = timer()
+    test_stats = run_eval(eval_step, state, test_batches, batch_size)
+    test_time = timer(test_time_in_total)
+    summary = {
+        "train time": train_time,
+        "train loss": train_acc.mean("loss"),
+        "train acc": train_acc.mean("correct"),
+        "test time": test_time,
+        "test loss": test_stats["loss"],
+        "test acc": test_stats["acc"],
+        "total time": timer.total_time,
+    }
+    # surface comm accounting when present (analytic bytes-on-wire, SURVEY §5)
+    if "comm/sent_elems" in train_acc.sums:
+        summary["sent frac"] = train_acc.mean("comm/sent_elems") / max(
+            train_acc.mean("comm/dense_elems"), 1.0
+        )
+    return state, summary

@@ -1,0 +1,42 @@
+"""CIFAR-sized AlexNet, module flavour (`CIFAR10/alexnet.py:11-57`).
+
+The reference computes loss/correct inside ``forward`` and returns a dict;
+here the module returns logits and the train step owns the loss — same
+capability, standard JAX factoring.  Dropout positions and the 256*2*2
+flatten match the reference exactly (input 32x32 -> features 2x2x256).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+__all__ = ["AlexNet"]
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # features (`alexnet.py:14-28`)
+        x = nn.Conv(64, (3, 3), strides=(2, 2), padding=1, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(192, (3, 3), padding=1, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(384, (3, 3), padding=1, name="conv3")(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding=1, name="conv4")(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding=1, name="conv5")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        # classifier (`alexnet.py:29-37`)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, name="fc1")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, name="fc2")(x))
+        return nn.Dense(self.num_classes, name="fc3")(x)

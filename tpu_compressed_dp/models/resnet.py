@@ -1,0 +1,145 @@
+"""ImageNet ResNet-18/34/50/101/152 (`IMAGENET/training/resnet.py`).
+
+Standard torchvision-era architecture (BasicBlock `resnet.py:24-56`,
+Bottleneck `:59-92`, ResNet `:95-151`) in NHWC flax.  ``bn0=True`` reproduces
+``init_dist_weights`` (`resnet.py:154-160` / ``--init-bn0``,
+`train_imagenet_nv.py:168`): the *last* BatchNorm of every residual block is
+gamma-zero-initialised and the final FC uses normal(0, 0.01) weights — the
+large-batch trick that makes each block start as identity.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
+
+_conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+_fc_bn0_init = nn.initializers.normal(0.01)
+
+
+def _bn(train: bool, name: str, zero_init: bool = False):
+    return nn.BatchNorm(
+        use_running_average=not train,
+        momentum=0.9,
+        epsilon=1e-5,
+        scale_init=nn.initializers.zeros if zero_init else nn.initializers.ones,
+        name=name,
+    )
+
+
+def _conv(features: int, kernel: int, stride: int = 1, name: str = None):
+    return nn.Conv(
+        features,
+        (kernel, kernel),
+        strides=(stride, stride),
+        padding=kernel // 2,
+        use_bias=False,
+        kernel_init=_conv_init,
+        name=name,
+    )
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    downsample: bool = False
+    bn0: bool = False
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        identity = x
+        out = _conv(self.features, 3, self.stride, name="conv1")(x)
+        out = _bn(train, "bn1")(out)
+        out = nn.relu(out)
+        out = _conv(self.features, 3, name="conv2")(out)
+        out = _bn(train, "bn2", zero_init=self.bn0)(out)
+        if self.downsample:
+            identity = _conv(self.features, 1, self.stride, name="ds_conv")(x)
+            identity = _bn(train, "ds_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    stride: int = 1
+    downsample: bool = False
+    bn0: bool = False
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        identity = x
+        out = _conv(self.features, 1, name="conv1")(x)
+        out = _bn(train, "bn1")(out)
+        out = nn.relu(out)
+        out = _conv(self.features, 3, self.stride, name="conv2")(out)
+        out = _bn(train, "bn2")(out)
+        out = nn.relu(out)
+        out = _conv(self.features * 4, 1, name="conv3")(out)
+        out = _bn(train, "bn3", zero_init=self.bn0)(out)
+        if self.downsample:
+            identity = _conv(self.features * 4, 1, self.stride, name="ds_conv")(x)
+            identity = _bn(train, "ds_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class ResNet(nn.Module):
+    block: Type[nn.Module]
+    layers: Sequence[int]
+    num_classes: int = 1000
+    bn0: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _conv(64, 7, 2, name="conv1")(x)
+        x = _bn(train, "bn1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        features = 64
+        in_features = 64
+        for stage, blocks in enumerate(self.layers):
+            stride = 1 if stage == 0 else 2
+            for b in range(blocks):
+                downsample = b == 0 and (
+                    stride != 1 or in_features != features * self.block.expansion
+                )
+                x = self.block(
+                    features,
+                    stride=stride if b == 0 else 1,
+                    downsample=downsample,
+                    bn0=self.bn0,
+                    name=f"layer{stage + 1}_{b}",
+                )(x, train)
+                in_features = features * self.block.expansion
+            features *= 2
+        x = x.mean(axis=(1, 2))  # global average pool (`resnet.py:117`)
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=_fc_bn0_init if self.bn0 else nn.initializers.lecun_normal(),
+            name="fc",
+        )(x)
+
+
+def resnet18(num_classes=1000, bn0=False):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, bn0)
+
+
+def resnet34(num_classes=1000, bn0=False):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, bn0)
+
+
+def resnet50(num_classes=1000, bn0=False):
+    """`resnet.py:187-196` — the ImageNet harness's flagship model."""
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, bn0)
+
+
+def resnet101(num_classes=1000, bn0=False):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, bn0)
+
+
+def resnet152(num_classes=1000, bn0=False):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, bn0)

@@ -1,0 +1,135 @@
+"""DAWNBench CIFAR-10 models from the graph-spec family.
+
+Re-designs of the reference's nested-dict graph networks
+(`CIFAR10/dawn.py:23-82` + the `build_graph`/`Network` interpreter,
+`core.py:136-141`, `torch_backend.py:107-118`) as plain flax modules: the
+DAG-with-cache interpreter is exactly what a pure jitted function is, so no
+graph runtime survives the port (SURVEY.md §3.5).  Layout is NHWC (TPU
+native) rather than the reference's NCHW.
+
+Architecture parity:
+  * ``ResNet9``  = `resnet9()` (`dawn.py:70-77`): prep conv_bn(64);
+    layer1 conv_bn(128)+pool+residual; layer2 conv_bn(256)+pool;
+    layer3 conv_bn(512)+pool+residual; maxpool4; linear(10, no bias);
+    logits scaled by 0.125 (`Mul(weight)`, `dawn.py:54`).
+  * ``AlexNetGraph`` = `alexnet()` (`dawn.py:57-68,79-82`).
+Both support the reference's knobs: channel dict, classifier weight, extra
+layers, residual placement, and BN init options (`torch_backend.py:92-103`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ConvBN", "Residual", "ResNet9", "AlexNetGraph"]
+
+BN_MOMENTUM = 0.9  # EMA decay == 1 - torch's BatchNorm momentum of 0.1
+BN_EPS = 1e-5
+
+
+class ConvBN(nn.Module):
+    """conv3x3(no bias) + BatchNorm + ReLU (`dawn.py:23-28`).
+
+    ``bn_weight_init``/``bn_bias_init`` mirror `batch_norm()` options
+    (`torch_backend.py:92-103`); freezing is handled at the optimizer level.
+    """
+
+    features: int
+    stride: int = 1
+    bn_weight_init: float = 1.0
+    bn_bias_init: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(
+            self.features,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=1,
+            use_bias=False,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPS,
+            scale_init=nn.initializers.constant(self.bn_weight_init),
+            bias_init=nn.initializers.constant(self.bn_bias_init),
+            name="bn",
+        )(x)
+        return nn.relu(x)
+
+
+class Residual(nn.Module):
+    """x + conv_bn(conv_bn(x)) (`dawn.py:37-43`)."""
+
+    features: int
+    bn_weight_init: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = ConvBN(self.features, bn_weight_init=self.bn_weight_init, name="res1")(x, train)
+        y = ConvBN(self.features, bn_weight_init=self.bn_weight_init, name="res2")(y, train)
+        return x + y
+
+
+def _maxpool(x, window: int):
+    return nn.max_pool(x, (window, window), strides=(window, window))
+
+
+class ResNet9(nn.Module):
+    """The 94%-in-79s DAWNBench net (`dawn.py:70-77`, `BASELINE.md`)."""
+
+    num_classes: int = 10
+    channels: Optional[Dict[str, int]] = None
+    classifier_weight: float = 0.125
+    res_layers: Sequence[str] = ("layer1", "layer3")
+    extra_layers: Sequence[str] = ()
+    bn_weight_init: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        ch = self.channels or {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}
+        x = ConvBN(ch["prep"], bn_weight_init=self.bn_weight_init, name="prep")(x, train)
+        for layer in ("layer1", "layer2", "layer3"):
+            x = ConvBN(ch[layer], bn_weight_init=self.bn_weight_init, name=layer)(x, train)
+            x = _maxpool(x, 2)
+            if layer in self.extra_layers:
+                x = ConvBN(ch[layer], bn_weight_init=self.bn_weight_init, name=f"{layer}_extra")(
+                    x, train
+                )
+            if layer in self.res_layers:
+                x = Residual(ch[layer], bn_weight_init=self.bn_weight_init, name=f"{layer}_residual")(
+                    x, train
+                )
+        x = _maxpool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, use_bias=False, name="linear")(x)
+        return x * self.classifier_weight
+
+
+class AlexNetGraph(nn.Module):
+    """The graph-spec AlexNet variant (`dawn.py:57-68,79-82`)."""
+
+    num_classes: int = 10
+    channels: Optional[Dict[str, int]] = None
+    classifier_weight: float = 0.125
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        ch = self.channels or {"prep": 64, "layer1": 192, "layer2": 384, "layer3": 256, "layer4": 256}
+        x = ConvBN(ch["prep"], stride=2, name="prep")(x, train)
+        x = _maxpool(x, 2)
+        x = ConvBN(ch["layer1"], name="layer1")(x, train)
+        x = _maxpool(x, 2)
+        x = ConvBN(ch["layer2"], name="layer2")(x, train)
+        x = ConvBN(ch["layer3"], name="layer3")(x, train)
+        x = ConvBN(ch["layer4"], name="layer4")(x, train)
+        x = _maxpool(x, 2)
+        x = _maxpool(x, 2)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, use_bias=False, name="linear")(x)
+        return x * self.classifier_weight

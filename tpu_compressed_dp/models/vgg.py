@@ -1,0 +1,77 @@
+"""VGG for CIFAR-10 (`CIFAR10/vgg16.py`).
+
+Configs A/B/D/E with optional BatchNorm, the torch-style adaptive 7x7 average
+pool (which *tiles* when the input is smaller than 7x7 — exactly what happens
+for 32x32 CIFAR inputs after five pools), and the reference's init scheme
+(`vgg16.py:55-66`): kaiming-normal(fan_out) convs, normal(0, 0.01) linears,
+zero biases.  ``vgg16()`` mirrors the module-level ``vgg16model`` singleton
+(`vgg16.py:94`): config D, no BN.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["VGG", "vgg16", "CFGS", "adaptive_avg_pool"]
+
+CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512, "M",
+          512, 512, 512, 512, "M"],
+}
+
+_conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+_fc_init = nn.initializers.normal(0.01)
+
+
+def adaptive_avg_pool(x, out_hw: int):
+    """torch ``AdaptiveAvgPool2d`` semantics on NHWC: output bin ``i`` averages
+    input rows ``floor(i*H/O) .. ceil((i+1)*H/O)-1``; tiles when H < O."""
+    n, h, w, c = x.shape
+    o = out_hw
+
+    def pool_axis(arr, size, axis):
+        slices = []
+        for i in range(o):
+            lo = (i * size) // o
+            hi = -(-((i + 1) * size) // o)  # ceil
+            sl = jnp.take(arr, jnp.arange(lo, hi), axis=axis)
+            slices.append(jnp.mean(sl, axis=axis, keepdims=True))
+        return jnp.concatenate(slices, axis=axis)
+
+    return pool_axis(pool_axis(x, h, 1), w, 2)
+
+
+class VGG(nn.Module):
+    cfg: Union[str, Sequence] = "D"
+    batch_norm: bool = False
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = CFGS[self.cfg] if isinstance(self.cfg, str) else self.cfg
+        for v in cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, kernel_init=_conv_init)(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5)(x)
+                x = nn.relu(x)
+        x = adaptive_avg_pool(x, 7)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, kernel_init=_fc_init, name="fc1")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, kernel_init=_fc_init, name="fc2")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, kernel_init=_fc_init, name="fc3")(x)
+
+
+def vgg16(num_classes: int = 10, batch_norm: bool = False) -> VGG:
+    return VGG(cfg="D", batch_norm=batch_norm, num_classes=num_classes)

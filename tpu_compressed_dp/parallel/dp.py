@@ -138,6 +138,13 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     )
     per_worker_rng = not cfg.resolved_shared_mask
 
+    def sent_count(comp_flat: jax.Array) -> jax.Array:
+        # Dense payloads carry every element regardless of value; only
+        # sparsifying methods get nonzero-counted.
+        if comp.name == "none":
+            return jnp.asarray(float(comp_flat.shape[0]), jnp.float32)
+        return jnp.count_nonzero(comp_flat).astype(jnp.float32)
+
     def compress_flat(flat: jax.Array, key: jax.Array, index: int) -> jax.Array:
         k = _leaf_key(key, index, per_worker_rng and comp.needs_rng, axis_name)
         return comp.fn(flat, k)
@@ -158,11 +165,11 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             comp_flat = compress_flat(acc, key, 0)
             new_ef_flat = acc - comp_flat
             reduced = jax.lax.psum(comp_flat, axis_name) / world
-            sent = jnp.count_nonzero(comp_flat)
+            sent = sent_count(comp_flat)
             out = unravel(reduced)
             new_ef = unravel(new_ef_flat) if use_ef else ()
             stats = {
-                "sent_elems": sent.astype(jnp.float32),
+                "sent_elems": sent,
                 "dense_elems": jnp.asarray(float(flat.shape[0]), jnp.float32),
                 "num_collectives": jnp.asarray(1.0, jnp.float32),
             }
@@ -181,7 +188,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
                 new_ef_leaves.append((acc - comp_flat).reshape(g.shape))
             reduced = jax.lax.psum(comp_flat, axis_name) / world
             out_leaves.append(reduced.reshape(g.shape))
-            sent_total = sent_total + jnp.count_nonzero(comp_flat).astype(jnp.float32)
+            sent_total = sent_total + sent_count(comp_flat)
             dense_total += float(flat.shape[0])
 
         out = jax.tree.unflatten(treedef, out_leaves)

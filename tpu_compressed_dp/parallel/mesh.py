@@ -78,15 +78,26 @@ def make_data_mesh(num_devices: Optional[int] = None, devices: Optional[Sequence
     """
     if devices is None:
         devices = jax.devices()
-        if num_devices is not None:
-            devices = devices[:num_devices]
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested a {num_devices}-device mesh but only "
+                f"{len(devices)} devices are available"
+            )
+        devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
 def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str]) -> Mesh:
     """General N-D mesh for composed parallelism (dp x tp x pp x sp ...)."""
     n = int(np.prod(axis_sizes))
-    devices = np.asarray(jax.devices()[:n]).reshape(tuple(axis_sizes))
+    available = jax.devices()
+    if n > len(available):
+        raise ValueError(
+            f"mesh {tuple(axis_sizes)} needs {n} devices but only "
+            f"{len(available)} are available"
+        )
+    devices = np.asarray(available[:n]).reshape(tuple(axis_sizes))
     return Mesh(devices, tuple(axis_names))
 
 

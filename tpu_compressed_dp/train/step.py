@@ -46,12 +46,18 @@ ApplyFn = Callable[[Any, Any, Array, bool, Dict[str, Array]], Tuple[Array, Any]]
 __all__ = ["make_train_step", "make_eval_step", "cross_entropy_sum"]
 
 
-def cross_entropy_sum(logits: Array, labels: Array) -> Array:
-    """Summed softmax cross-entropy (`nn.CrossEntropyLoss(reduction='none')``
-    then ``.sum()``, `dawn.py:85` + `core.py:310`)."""
+def cross_entropy_per_example(logits: Array, labels: Array) -> Array:
+    """Per-example softmax cross-entropy (`nn.CrossEntropyLoss(reduction='none')`,
+    `dawn.py:85`).  Out-of-range labels (eval padding) contribute 0."""
     logz = jax.nn.log_softmax(logits.astype(jnp.float32))
-    ll = jnp.take_along_axis(logz, labels[:, None], axis=1)[:, 0]
-    return -jnp.sum(ll)
+    safe = jnp.clip(labels, 0, logits.shape[-1] - 1)
+    ll = jnp.take_along_axis(logz, safe[:, None], axis=1)[:, 0]
+    return jnp.where((labels >= 0) & (labels < logits.shape[-1]), -ll, 0.0)
+
+
+def cross_entropy_sum(logits: Array, labels: Array) -> Array:
+    """Summed softmax cross-entropy (`core.py:310`)."""
+    return jnp.sum(cross_entropy_per_example(logits, labels))
 
 
 def make_train_step(
@@ -167,23 +173,29 @@ def optimizer_lr(optimizer: SGD, step: Array) -> Array:
 
 
 def make_eval_step(apply_fn: ApplyFn, mesh: Mesh, *, axis_name: str = "data"):
-    """Build ``eval_step(state, batch) -> {loss_sum, correct, count}`` (global sums).
+    """Build ``eval_step(state, batch) -> {loss_sum, correct, correct5, count}``
+    (global sums).
 
     Equivalent of the reference's eval pass (`core.py:326`) and the global
     metric reduction of ``distributed_predict`` (`train_imagenet_nv.py:523-542`).
+    ``batch`` may carry a ``'mask'`` array (1.0 = real example, 0.0 = padding);
+    padded examples contribute to no metric — the TPU answer to the
+    reference's uneven-final-batch problem (`DistValSampler`,
+    `dataloader.py:133-161`, hands ranks possibly-empty batches; we pad to a
+    static shape instead so XLA sees one shape per image size).
     """
 
-    def local_eval(state: TrainState, x: Array, y: Array):
+    def local_eval(state: TrainState, x: Array, y: Array, mask: Array):
         logits, _ = apply_fn(state.params, state.batch_stats, x, False, {})
-        loss = cross_entropy_sum(logits, y)
-        correct1 = jnp.sum(jnp.argmax(logits, axis=1) == y).astype(jnp.float32)
+        loss = jnp.sum(cross_entropy_per_example(logits, y) * mask)
+        correct1 = jnp.sum((jnp.argmax(logits, axis=1) == y) * mask)
         top5 = jax.lax.top_k(logits, min(5, logits.shape[-1]))[1]
-        correct5 = jnp.sum(jnp.any(top5 == y[:, None], axis=1)).astype(jnp.float32)
+        correct5 = jnp.sum(jnp.any(top5 == y[:, None], axis=1) * mask)
         return {
             "loss_sum": jax.lax.psum(loss, axis_name),
             "correct": jax.lax.psum(correct1, axis_name),
             "correct5": jax.lax.psum(correct5, axis_name),
-            "count": jax.lax.psum(jnp.asarray(x.shape[0], jnp.float32), axis_name),
+            "count": jax.lax.psum(jnp.sum(mask), axis_name),
         }
 
     state_spec = TrainState(
@@ -192,12 +204,15 @@ def make_eval_step(apply_fn: ApplyFn, mesh: Mesh, *, axis_name: str = "data"):
     sharded = shard_map(
         local_eval,
         mesh=mesh,
-        in_specs=(state_spec, P(axis_name), P(axis_name)),
+        in_specs=(state_spec, P(axis_name), P(axis_name), P(axis_name)),
         out_specs=P(),
     )
 
     @jax.jit
     def eval_step(state: TrainState, batch: Dict[str, Array]):
-        return sharded(state, batch["input"], batch["target"])
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones((batch["target"].shape[0],), jnp.float32)
+        return sharded(state, batch["input"], batch["target"], mask)
 
     return eval_step

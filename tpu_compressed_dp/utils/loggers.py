@@ -1,0 +1,105 @@
+"""Training loggers: console table, TSV, and metric averaging.
+
+Covers the reference's CIFAR logging stack — ``TableLogger``
+(`CIFAR10/core.py:31-37`), ``TSVLogger`` (`dawn.py:89-96`, the DAWNBench
+submission format), ``StatsLogger`` (`core.py:161-173`) — plus meters from the
+ImageNet side (`IMAGENET/training/meter.py:4-22`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List
+
+__all__ = ["TableLogger", "TSVLogger", "AverageMeter", "MetricAccumulator"]
+
+
+class TableLogger:
+    """Fixed-width console table; columns locked to the first row's keys."""
+
+    def append(self, output: Dict) -> None:
+        if not hasattr(self, "keys"):
+            self.keys = list(output.keys())
+            print(*(f"{k:>12s}" for k in self.keys))
+        filtered = [output.get(k) for k in self.keys]
+        print(*(f"{v:12.4f}" if isinstance(v, float) else f"{v!s:>12}" for v in filtered))
+
+
+class TSVLogger:
+    """DAWNBench `epoch\\thours\\ttop1Accuracy` log (`dawn.py:89-96`)."""
+
+    def __init__(self):
+        self.log: List[str] = ["epoch\thours\ttop1Accuracy"]
+
+    def append(self, output: Dict) -> None:
+        epoch = output["epoch"]
+        hours = output["total time"] / 3600
+        acc = output["test acc"] * 100
+        self.log.append(f"{epoch}\t{hours:.8f}\t{acc:.2f}")
+
+    def save(self, log_dir: str, name: str = "logs.tsv") -> str:
+        log_dir = os.path.expanduser(log_dir)
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir, name)
+        with open(path, "w") as f:
+            f.write(str(self))
+        return path
+
+    def __str__(self) -> str:
+        return "\n".join(self.log)
+
+
+class AverageMeter:
+    """Running value/average/smoothed view (`meter.py:4-22`)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.avg = 0.0
+        self.smooth_avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.smooth_avg = val if self.count == n else self.smooth_avg * 0.9 + val * 0.1
+        self.avg = self.sum / self.count
+
+
+class MetricAccumulator:
+    """Accumulates per-step metric dicts into epoch means/sums.
+
+    The framework-native replacement for ``StatsLogger`` (`core.py:161-173`):
+    metrics arrive already globally reduced from the train step, so this is
+    pure host-side bookkeeping.
+    """
+
+    #: keys that are global sums per step (everything else is a per-example or
+    #: per-step value, averaged with the step's example count as weight)
+    SUM_KEYS = frozenset({"correct", "correct5", "count", "loss_sum"})
+
+    def __init__(self):
+        self.sums: Dict[str, float] = {}
+        self.weights: Dict[str, float] = {}
+
+    def update(self, metrics: Dict[str, float]) -> None:
+        w = float(metrics.get("count", 1.0))
+        for k, v in metrics.items():
+            v = float(v)
+            if k in self.SUM_KEYS:
+                self.sums[k] = self.sums.get(k, 0.0) + v
+            else:
+                self.sums[k] = self.sums.get(k, 0.0) + v * w
+                self.weights[k] = self.weights.get(k, 0.0) + w
+
+    def mean(self, key: str) -> float:
+        if key in self.SUM_KEYS:
+            return self.sums[key] / max(self.sums.get("count", 1.0), 1e-12)
+        return self.sums[key] / max(self.weights[key], 1e-12)
+
+    def sum(self, key: str) -> float:
+        return self.sums[key]

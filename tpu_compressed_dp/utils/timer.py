@@ -1,0 +1,40 @@
+"""Wall-clock timing with device synchronisation.
+
+Equivalent of the reference ``Timer`` (`CIFAR10/core.py:14-27`), which was
+instantiated with ``torch.cuda.synchronize`` (`dawn.py:129`); on JAX the sync
+is ``block_until_ready`` on a sentinel device value.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["Timer", "device_sync"]
+
+
+def device_sync() -> None:
+    """Block until all enqueued device work is complete."""
+    for d in jax.live_arrays():
+        d.block_until_ready()
+
+
+class Timer:
+    """Split timer: each call returns the delta since the previous call and
+    (optionally) accumulates it into ``total_time`` (`core.py:21-27`)."""
+
+    def __init__(self, synch: Optional[Callable[[], None]] = None):
+        self.synch = synch or (lambda: None)
+        self.synch()
+        self.times = [time.time()]
+        self.total_time = 0.0
+
+    def __call__(self, include_in_total: bool = True) -> float:
+        self.synch()
+        self.times.append(time.time())
+        delta_t = self.times[-1] - self.times[-2]
+        if include_in_total:
+            self.total_time += delta_t
+        return delta_t
