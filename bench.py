@@ -1,0 +1,101 @@
+"""Headline benchmark: CIFAR-10 ResNet-9 training throughput (images/sec).
+
+Baseline: the reference's DAWNBench result — 24 epochs x 50,000 images in 79 s
+on one V100 (`/root/reference/CIFAR10/README.md:3`, SURVEY.md §6) =
+~15,190 images/sec end-to-end.  We measure the same workload's steady-state
+train-step throughput (forward + backward + gradient sync + SGD update,
+batch 512) on whatever devices are attached and report
+``vs_baseline = ours / 15190``.
+
+Prints exactly ONE JSON line on stdout; progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_IMAGES_PER_SEC = 24 * 50_000 / 79.0  # reference DAWNBench, 1x V100
+
+
+def main() -> None:
+    from tpu_compressed_dp.data import cifar10 as data
+    from tpu_compressed_dp.harness.dawn import MODELS
+    from tpu_compressed_dp.models.common import init_model, make_apply_fn
+    from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
+    from tpu_compressed_dp.parallel.mesh import make_data_mesh
+    from tpu_compressed_dp.train.optim import SGD
+    from tpu_compressed_dp.train.schedules import piecewise_linear
+    from tpu_compressed_dp.train.state import TrainState
+    from tpu_compressed_dp.train.step import make_train_step
+
+    mesh = make_data_mesh()
+    ndev = mesh.shape["data"]
+    bs = 512
+    if bs % ndev:
+        bs = (bs // ndev + 1) * ndev
+    print(f"devices={ndev} ({jax.devices()[0].platform}), batch={bs}", file=sys.stderr)
+
+    module = MODELS["resnet9"]()
+    params, stats = init_model(
+        module, jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32)
+    )
+    apply_fn = make_apply_fn(module)
+
+    sched = piecewise_linear([0, 5, 24], [0, 0.4, 0])
+    steps_per_epoch = 50_000 // bs
+    opt = SGD(
+        lr=lambda s: sched(s / steps_per_epoch) / bs,
+        momentum=0.9,
+        nesterov=True,
+        weight_decay=5e-4 * bs,
+    )
+    comp = CompressionConfig(method=None)
+    state = TrainState.create(
+        params, stats, opt.init(params), init_ef_state(params, comp, ndev),
+        jax.random.key(1),
+    )
+    train_step = make_train_step(apply_fn, opt, comp, mesh, grad_scale=float(bs))
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(
+            rng.standard_normal((bs, 32, 32, 3), dtype=np.float32)
+        ),
+        "target": jnp.asarray(rng.integers(0, 10, size=(bs,), dtype=np.int32)),
+    }
+
+    # Warmup: compile + settle (the reference's warmup_cudnn analog,
+    # `torch_backend.py:18-29`).
+    for _ in range(3):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics)
+
+    timed_steps = 40
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = timed_steps * bs / dt
+    print(f"{timed_steps} steps in {dt:.3f}s", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_resnet9_train_images_per_sec",
+                "value": round(images_per_sec, 1),
+                "unit": "images/sec",
+                "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

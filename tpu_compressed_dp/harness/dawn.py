@@ -13,7 +13,8 @@ Differences from the reference (intended behaviour, SURVEY.md §2.3):
     against the misspelling 'Resent9' and crashed on its own default);
   * the entire-model path works;
   * rendezvous/mesh come from JAX (no --master_address/--rank plumbing needed
-    single-host; multi-host uses ``distributed_init``).
+    single-host; multi-host rendezvous flags exist but per-process batch
+    sharding is not wired up yet — the harness refuses rather than mis-feeds).
 
 Run: ``python -m tpu_compressed_dp.harness.dawn --synthetic --epochs 2``
 """
@@ -101,6 +102,12 @@ def default_epochs(method: str) -> int:
 
 def run(args) -> dict:
     distributed_init(args.coordinator, args.num_processes, args.process_id)
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "multi-process CIFAR harness needs per-process batch sharding "
+            "(jax.make_array_from_process_local_data); single-process "
+            "multi-chip meshes are fully supported"
+        )
     mesh = make_data_mesh(args.devices)
     ndev = mesh.shape["data"]
     epochs = args.epochs if args.epochs is not None else default_epochs(args.method)
@@ -129,7 +136,11 @@ def run(args) -> dict:
                                jnp.zeros((1, 32, 32, 3), jnp.float32))
 
     steps_per_epoch = len(train_batches)
-    sched = piecewise_linear([0, 5, epochs], [0, args.peak_lr, 0])
+    # `dawn.py:110`: ramp to peak at epoch 5, anneal to 0 at `epochs`.  For
+    # short (smoke) runs the ramp point is pulled in so the knots stay strictly
+    # increasing and the schedule still anneals to 0.
+    ramp_ep = 5 if epochs > 5 else epochs / 2
+    sched = piecewise_linear([0, ramp_ep, epochs], [0, args.peak_lr, 0])
     lr = lambda step: sched(step / steps_per_epoch) / bs  # noqa: E731 (`dawn.py:142`)
     opt = SGD(
         lr=lr,
@@ -138,6 +149,11 @@ def run(args) -> dict:
         weight_decay=5e-4 * bs,
     )
 
+    if args.method.lower() != "none" and args.compress == "none":
+        raise ValueError(
+            f"--method {args.method} requires --compress layerwise|entiremodel "
+            "(the reference silently trained dense here; we refuse instead)"
+        )
     comp = CompressionConfig(
         method=None if args.compress == "none" or args.method.lower() == "none" else args.method,
         granularity=args.compress if args.compress != "none" else "layerwise",

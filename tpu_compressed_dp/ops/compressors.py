@@ -44,6 +44,7 @@ __all__ = [
     "terngrad",
     "random_dithering",
     "get_compressor",
+    "payload_bits_per_elem",
     "REGISTRY",
     "topk_keep_count",
     "randomk_keep_count",
@@ -175,6 +176,41 @@ class _Bound:
     name: str
     fn: CompressorFn
     needs_rng: bool
+
+    @property
+    def is_sparsifier(self) -> bool:
+        """Sparsifiers send only the surviving coordinates; quantizers
+        (terngrad/qsgd) and identity send every coordinate at reduced width."""
+        return self.name in ("topk", "randomk", "thresholdv", "adaptive_threshold")
+
+
+def payload_bits_per_elem(
+    name: str, *, qstates: int = 255, shared_mask: bool = False
+) -> float:
+    """Analytic wire width of one transmitted element, in bits.
+
+    This is the accounting the reference measured empirically from
+    /proc/net/dev (`meter.py:24-47`); on TPU the payload layout is known:
+      * dense fp32 value: 32;
+      * sparsifier: 32-bit value + 32-bit index, except shared-seed Random-K
+        whose indices are implied by the common PRNG key
+        (`sparsified_ddp.py:164` — only k values travel, `:412`);
+      * TernGrad: 2 bits per element (3 levels) + one fp32 scale (amortised);
+      * QSGD/random dithering: sign + ceil(log2(qstates+1)) level bits + one
+        fp32 norm (amortised) — the QSGD paper's variable-length bound is
+        tighter but this is the fixed-width layout a TPU kernel would pack.
+    """
+    import math
+
+    if name in ("none", "thresholdv", "adaptive_threshold", "topk"):
+        return 32.0 if name == "none" else 64.0
+    if name == "randomk":
+        return 32.0 if shared_mask else 64.0
+    if name == "terngrad":
+        return 2.0
+    if name == "qsgd":
+        return 1.0 + math.ceil(math.log2(qstates + 1))
+    raise ValueError(f"unknown compressor {name!r}")
 
 
 # Canonical names plus the reference CLI spellings (`dawn.py:16`,

@@ -120,8 +120,10 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     ``comm_stats`` reports per-step communication analytically (SURVEY.md §5:
     the reference measured NIC bytes via /proc/net/dev; on TPU the payload is
     known at trace time for fixed-k methods and counted at run time for
-    threshold methods): ``sent_elems`` is what the wire representation would
-    carry, ``dense_elems`` the uncompressed size.
+    threshold methods): ``sent_elems`` is the element count the wire
+    representation would carry, ``sent_bits`` its analytic bit volume
+    (quantizers send every element at 2-9 bits), ``dense_elems`` the
+    uncompressed size.
     """
     if cfg.mode == "wire":
         try:
@@ -137,11 +139,15 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         cfg.method, ratio=cfg.ratio, threshold=cfg.threshold, qstates=cfg.qstates
     )
     per_worker_rng = not cfg.resolved_shared_mask
+    bits_per_elem = compressors.payload_bits_per_elem(
+        comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask
+    )
 
     def sent_count(comp_flat: jax.Array) -> jax.Array:
-        # Dense payloads carry every element regardless of value; only
-        # sparsifying methods get nonzero-counted.
-        if comp.name == "none":
+        # Sparsifiers transmit only surviving coordinates; quantizers
+        # (terngrad/qsgd) and identity carry every element — at a reduced
+        # per-element width accounted by `bits_per_elem`.
+        if not comp.is_sparsifier:
             return jnp.asarray(float(comp_flat.shape[0]), jnp.float32)
         return jnp.count_nonzero(comp_flat).astype(jnp.float32)
 
@@ -170,6 +176,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             new_ef = unravel(new_ef_flat) if use_ef else ()
             stats = {
                 "sent_elems": sent,
+                "sent_bits": sent * bits_per_elem,
                 "dense_elems": jnp.asarray(float(flat.shape[0]), jnp.float32),
                 "num_collectives": jnp.asarray(1.0, jnp.float32),
             }
@@ -195,6 +202,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         new_ef = jax.tree.unflatten(treedef, new_ef_leaves) if use_ef else ()
         stats = {
             "sent_elems": sent_total,
+            "sent_bits": sent_total * bits_per_elem,
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
             "num_collectives": jnp.asarray(float(len(leaves)), jnp.float32),
         }
