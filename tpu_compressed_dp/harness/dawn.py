@@ -101,6 +101,12 @@ def default_epochs(method: str) -> int:
 
 
 def run(args) -> dict:
+    # Pure CLI-flag consistency first, before any I/O or device work.
+    if args.method.lower() != "none" and args.compress == "none":
+        raise ValueError(
+            f"--method {args.method} requires --compress layerwise|entiremodel "
+            "(the reference silently trained dense here; we refuse instead)"
+        )
     distributed_init(args.coordinator, args.num_processes, args.process_id)
     if jax.process_count() > 1:
         raise NotImplementedError(
@@ -149,11 +155,6 @@ def run(args) -> dict:
         weight_decay=5e-4 * bs,
     )
 
-    if args.method.lower() != "none" and args.compress == "none":
-        raise ValueError(
-            f"--method {args.method} requires --compress layerwise|entiremodel "
-            "(the reference silently trained dense here; we refuse instead)"
-        )
     comp = CompressionConfig(
         method=None if args.compress == "none" or args.method.lower() == "none" else args.method,
         granularity=args.compress if args.compress != "none" else "layerwise",
@@ -173,9 +174,10 @@ def run(args) -> dict:
     eval_step = make_eval_step(apply_fn, mesh)
 
     table, tsv = TableLogger(), TSVLogger()
-    # No explicit device sync needed: the loop materialises every step's
-    # metrics to Python floats, which blocks on the device work (the role
-    # torch.cuda.synchronize played in `dawn.py:129`).
+    # No explicit device sync needed: run_train_epoch keeps metrics on device
+    # during the epoch (async dispatch overlaps host batch prep with device
+    # work) and its end-of-epoch device_get blocks on everything outstanding —
+    # the role torch.cuda.synchronize played in `dawn.py:129`.
     timer = Timer()
     summary = {}
     for epoch in range(epochs):

@@ -48,6 +48,9 @@ __all__ = [
     "REGISTRY",
     "topk_keep_count",
     "randomk_keep_count",
+    "terngrad_levels",
+    "qsgd_levels",
+    "leaf_key",
 ]
 
 # A compressor maps a flat fp32 gradient and a PRNG key to a same-shaped
@@ -90,6 +93,20 @@ def randomk_keep_count(n: int, ratio: float) -> int:
 def identity(g: Array, key: Optional[Array] = None) -> Array:
     """No compression (the reference's dense fallback, `core.py:215`)."""
     return _flat(g)
+
+
+def leaf_key(key: Array, index: int, per_worker: bool, axis_name: str) -> Array:
+    """Per-leaf (and optionally per-worker) PRNG key derivation.
+
+    Shared by the simulate and wire sync engines so that the two modes draw
+    identical randomness for identical configs: fold in the leaf index always,
+    and the worker's mesh position only when masks/dither must *differ* across
+    workers (``per_worker=True``; must then be called inside ``shard_map``).
+    """
+    k = jax.random.fold_in(key, index)
+    if per_worker:
+        k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+    return k
 
 
 def top_k(g: Array, key: Optional[Array] = None, *, ratio: float) -> Array:
@@ -136,37 +153,57 @@ def adaptive_threshold(g: Array, key: Optional[Array] = None) -> Array:
     return jnp.where(2.0 * jnp.abs(g) >= gmax, g, 0.0)
 
 
-def terngrad(g: Array, key: Array) -> Array:
-    """TernGrad ternarisation (`core.py:200-206`).
+def terngrad_levels(g: Array, key: Array) -> tuple[Array, Array]:
+    """TernGrad's integer representation: ``(levels int8 in {-1,0,1}, scale)``.
 
-    ``out_i = max|g| * sign(g_i) * Bernoulli(|g_i| / max|g|)`` — an unbiased
-    estimator of ``g``.  A zero gradient maps to zero (the reference would
-    produce NaN via 0/0; SURVEY.md §2.3 intended-behaviour rule).
+    The dense estimator is ``scale * levels``; the wire path transmits the
+    int8 levels + one scale instead.  A zero gradient maps to zero levels
+    (the reference would produce NaN via 0/0; SURVEY.md §2.3).
     """
     g = _flat(g)
     mag = jnp.abs(g)
     gmax = jnp.max(mag)
     prob = jnp.where(gmax > 0, mag / jnp.where(gmax > 0, gmax, 1.0), 0.0)
     coin = jax.random.uniform(key, g.shape, dtype=g.dtype)
-    keep = (coin < prob).astype(g.dtype)
-    return jnp.sign(g) * gmax * keep
+    levels = (jnp.sign(g) * (coin < prob)).astype(jnp.int8)
+    return levels, gmax
+
+
+def terngrad(g: Array, key: Array) -> Array:
+    """TernGrad ternarisation (`core.py:200-206`).
+
+    ``out_i = max|g| * sign(g_i) * Bernoulli(|g_i| / max|g|)`` — an unbiased
+    estimator of ``g``.
+    """
+    levels, scale = terngrad_levels(g, key)
+    return scale * levels.astype(g.dtype)
+
+
+def qsgd_levels(g: Array, key: Array, *, qstates: int = 255) -> tuple[Array, Array]:
+    """QSGD's integer representation: ``(sign⊗level int16 in [-s, s], scale)``.
+
+    The dense estimator is ``scale * levels``; the wire path transmits the
+    int16 levels + one scale.  ``scale = ||g||/s`` with the reference's
+    zero-norm → zero-output guard (`core.py:213`) folded into the scale.
+    """
+    g = _flat(g)
+    norm = jnp.linalg.norm(g)
+    safe_norm = jnp.where(norm > 0, norm, 1.0)
+    u = jax.random.uniform(key, g.shape, dtype=g.dtype)
+    levels = jnp.floor(jnp.abs(g) / safe_norm * qstates + u)  # in [0, qstates]
+    levels = (jnp.sign(g) * levels).astype(jnp.int16)
+    scale = jnp.where(norm > 0, norm, 0.0) / qstates
+    return levels, scale
 
 
 def random_dithering(g: Array, key: Array, *, qstates: int = 255) -> Array:
     """Random dithering / QSGD quantisation (`core.py:207-213`).
 
     ``out_i = ||g||_2 * sign(g_i) * floor(|g_i|/||g|| * s + u_i) / s`` with
-    ``u_i ~ U[0,1)`` — unbiased stochastic rounding onto ``s`` levels.  The
-    reference maps Inf to 0 (`core.py:213`); we guard the zero-norm case the
-    same way.
+    ``u_i ~ U[0,1)`` — unbiased stochastic rounding onto ``s`` levels.
     """
-    g = _flat(g)
-    norm = jnp.linalg.norm(g)
-    safe_norm = jnp.where(norm > 0, norm, 1.0)
-    u = jax.random.uniform(key, g.shape, dtype=g.dtype)
-    levels = jnp.floor(jnp.abs(g) / safe_norm * qstates + u)
-    out = jnp.sign(g) * norm * levels / qstates
-    return jnp.where(norm > 0, out, jnp.zeros_like(g))
+    levels, scale = qsgd_levels(g, key, qstates=qstates)
+    return scale * levels.astype(g.dtype)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -102,13 +102,6 @@ def init_ef_state(grads_like: Any, cfg: CompressionConfig, num_devices: Optional
     )
 
 
-def _leaf_key(key: jax.Array, index: int, per_worker: bool, axis_name: str) -> jax.Array:
-    k = jax.random.fold_in(key, index)
-    if per_worker:
-        k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
-    return k
-
-
 def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     """Build ``sync(grads, ef, key) -> (synced_grads, new_ef, comm_stats)``.
 
@@ -125,19 +118,15 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     (quantizers send every element at 2-9 bits), ``dense_elems`` the
     uncompressed size.
     """
-    if cfg.mode == "wire":
-        try:
-            from tpu_compressed_dp.ops import wire  # deferred: optional fast path
-        except ImportError as e:
-            raise NotImplementedError(
-                "mode='wire' requires tpu_compressed_dp.ops.wire, which is not "
-                "available in this build; use mode='simulate'"
-            ) from e
-        return wire.make_wire_grad_sync(cfg, axis_name)
-
     comp = compressors.get_compressor(
         cfg.method, ratio=cfg.ratio, threshold=cfg.threshold, qstates=cfg.qstates
     )
+    if cfg.mode == "wire" and comp.name != "none":
+        # Dense (method=None) has no sparse representation — the simulate
+        # path's full-size psum IS its wire format, so fall through.
+        from tpu_compressed_dp.ops import wire
+
+        return wire.make_wire_grad_sync(cfg, axis_name)
     per_worker_rng = not cfg.resolved_shared_mask
     bits_per_elem = compressors.payload_bits_per_elem(
         comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask
@@ -152,7 +141,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         return jnp.count_nonzero(comp_flat).astype(jnp.float32)
 
     def compress_flat(flat: jax.Array, key: jax.Array, index: int) -> jax.Array:
-        k = _leaf_key(key, index, per_worker_rng and comp.needs_rng, axis_name)
+        k = compressors.leaf_key(key, index, per_worker_rng and comp.needs_rng, axis_name)
         return comp.fn(flat, k)
 
     def sync(grads: Any, ef: Any, key: jax.Array) -> Tuple[Any, Any, Dict[str, jax.Array]]:
