@@ -18,13 +18,29 @@ from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.utils.loggers import MetricAccumulator
 from tpu_compressed_dp.utils.timer import Timer
 
-__all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch"]
+__all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch", "comm_summary"]
+
+
+def comm_summary(acc: "MetricAccumulator") -> Dict[str, float]:
+    """Epoch comm accounting (analytic bytes-on-wire, SURVEY.md §5): 'sent
+    frac' = elements that travel; 'wire frac' = bits that travel vs a dense
+    fp32 allreduce (catches quantizers, whose element count is dense but whose
+    width is 2-9 bits).  Empty when compression metrics are absent."""
+    if "comm/sent_elems" not in acc.sums:
+        return {}
+    dense = max(acc.mean("comm/dense_elems"), 1.0)
+    return {
+        "sent frac": acc.mean("comm/sent_elems") / dense,
+        "wire frac": acc.mean("comm/sent_bits") / (32.0 * dense),
+    }
 
 
 def pad_batch(batch: Dict[str, np.ndarray], size: int) -> Dict[str, np.ndarray]:
     """Pad a (possibly short) final batch to a static ``size`` with a 0/1 mask,
     so every eval step sees one shape (no per-shape recompiles)."""
     n = len(batch["target"])
+    if n == size and "mask" in batch:
+        return batch
     mask = np.zeros((size,), np.float32)
     mask[:n] = 1.0
     if n == size:
@@ -92,12 +108,5 @@ def train_epoch(
         "test acc": test_stats["acc"],
         "total time": timer.total_time,
     }
-    # surface comm accounting when present (analytic bytes-on-wire, SURVEY §5):
-    # 'sent frac' = elements that travel; 'wire frac' = bits that travel vs a
-    # dense fp32 allreduce (catches quantizers, whose element count is dense
-    # but whose width is 2-9 bits).
-    if "comm/sent_elems" in train_acc.sums:
-        dense = max(train_acc.mean("comm/dense_elems"), 1.0)
-        summary["sent frac"] = train_acc.mean("comm/sent_elems") / dense
-        summary["wire frac"] = train_acc.mean("comm/sent_bits") / (32.0 * dense)
+    summary.update(comm_summary(train_acc))
     return state, summary

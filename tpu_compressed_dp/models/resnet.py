@@ -6,13 +6,21 @@ Bottleneck `:59-92`, ResNet `:95-151`) in NHWC flax.  ``bn0=True`` reproduces
 `train_imagenet_nv.py:168`): the *last* BatchNorm of every residual block is
 gamma-zero-initialised and the final FC uses normal(0, 0.01) weights — the
 large-batch trick that makes each block start as identity.
+
+``dtype=jnp.bfloat16`` is the TPU-native answer to the reference's fp16
+machinery (`fp16util.py`: ``network_to_half`` + fp32 master params + static
+loss scale 1024, `train_imagenet_nv.py:61`): flax keeps ``param_dtype=float32``
+(the master copy — gradients and updates are fp32 automatically) while the
+compute graph runs in bf16 on the MXU.  bf16's fp32-sized exponent removes the
+need for loss scaling.
 """
 
 from __future__ import annotations
 
-from typing import Sequence, Type
+from typing import Any, Sequence, Type
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
 
@@ -20,17 +28,19 @@ _conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal"
 _fc_bn0_init = nn.initializers.normal(0.01)
 
 
-def _bn(train: bool, name: str, zero_init: bool = False):
+def _bn(train: bool, name: str, zero_init: bool = False, dtype: Any = jnp.float32):
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=0.9,
         epsilon=1e-5,
         scale_init=nn.initializers.zeros if zero_init else nn.initializers.ones,
+        dtype=dtype,
         name=name,
     )
 
 
-def _conv(features: int, kernel: int, stride: int = 1, name: str = None):
+def _conv(features: int, kernel: int, stride: int = 1, name: str = None,
+          dtype: Any = jnp.float32):
     return nn.Conv(
         features,
         (kernel, kernel),
@@ -38,6 +48,7 @@ def _conv(features: int, kernel: int, stride: int = 1, name: str = None):
         padding=kernel // 2,
         use_bias=False,
         kernel_init=_conv_init,
+        dtype=dtype,
         name=name,
     )
 
@@ -47,19 +58,20 @@ class BasicBlock(nn.Module):
     stride: int = 1
     downsample: bool = False
     bn0: bool = False
+    dtype: Any = jnp.float32
     expansion = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         identity = x
-        out = _conv(self.features, 3, self.stride, name="conv1")(x)
-        out = _bn(train, "bn1")(out)
+        out = _conv(self.features, 3, self.stride, name="conv1", dtype=self.dtype)(x)
+        out = _bn(train, "bn1", dtype=self.dtype)(out)
         out = nn.relu(out)
-        out = _conv(self.features, 3, name="conv2")(out)
-        out = _bn(train, "bn2", zero_init=self.bn0)(out)
+        out = _conv(self.features, 3, name="conv2", dtype=self.dtype)(out)
+        out = _bn(train, "bn2", zero_init=self.bn0, dtype=self.dtype)(out)
         if self.downsample:
-            identity = _conv(self.features, 1, self.stride, name="ds_conv")(x)
-            identity = _bn(train, "ds_bn")(identity)
+            identity = _conv(self.features, 1, self.stride, name="ds_conv", dtype=self.dtype)(x)
+            identity = _bn(train, "ds_bn", dtype=self.dtype)(identity)
         return nn.relu(out + identity)
 
 
@@ -68,22 +80,23 @@ class Bottleneck(nn.Module):
     stride: int = 1
     downsample: bool = False
     bn0: bool = False
+    dtype: Any = jnp.float32
     expansion = 4
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         identity = x
-        out = _conv(self.features, 1, name="conv1")(x)
-        out = _bn(train, "bn1")(out)
+        out = _conv(self.features, 1, name="conv1", dtype=self.dtype)(x)
+        out = _bn(train, "bn1", dtype=self.dtype)(out)
         out = nn.relu(out)
-        out = _conv(self.features, 3, self.stride, name="conv2")(out)
-        out = _bn(train, "bn2")(out)
+        out = _conv(self.features, 3, self.stride, name="conv2", dtype=self.dtype)(out)
+        out = _bn(train, "bn2", dtype=self.dtype)(out)
         out = nn.relu(out)
-        out = _conv(self.features * 4, 1, name="conv3")(out)
-        out = _bn(train, "bn3", zero_init=self.bn0)(out)
+        out = _conv(self.features * 4, 1, name="conv3", dtype=self.dtype)(out)
+        out = _bn(train, "bn3", zero_init=self.bn0, dtype=self.dtype)(out)
         if self.downsample:
-            identity = _conv(self.features * 4, 1, self.stride, name="ds_conv")(x)
-            identity = _bn(train, "ds_bn")(identity)
+            identity = _conv(self.features * 4, 1, self.stride, name="ds_conv", dtype=self.dtype)(x)
+            identity = _bn(train, "ds_bn", dtype=self.dtype)(identity)
         return nn.relu(out + identity)
 
 
@@ -92,15 +105,18 @@ class ResNet(nn.Module):
     layers: Sequence[int]
     num_classes: int = 1000
     bn0: bool = False
+    dtype: Any = jnp.float32
+    width: int = 64
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = _conv(64, 7, 2, name="conv1")(x)
-        x = _bn(train, "bn1")(x)
+        x = x.astype(self.dtype)
+        x = _conv(self.width, 7, 2, name="conv1", dtype=self.dtype)(x)
+        x = _bn(train, "bn1", dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        features = 64
-        in_features = 64
+        features = self.width
+        in_features = self.width
         for stage, blocks in enumerate(self.layers):
             stride = 1 if stage == 0 else 2
             for b in range(blocks):
@@ -112,6 +128,7 @@ class ResNet(nn.Module):
                     stride=stride if b == 0 else 1,
                     downsample=downsample,
                     bn0=self.bn0,
+                    dtype=self.dtype,
                     name=f"layer{stage + 1}_{b}",
                 )(x, train)
                 in_features = features * self.block.expansion
@@ -120,26 +137,27 @@ class ResNet(nn.Module):
         return nn.Dense(
             self.num_classes,
             kernel_init=_fc_bn0_init if self.bn0 else nn.initializers.lecun_normal(),
+            dtype=self.dtype,
             name="fc",
-        )(x)
+        )(x).astype(jnp.float32)
 
 
-def resnet18(num_classes=1000, bn0=False):
-    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, bn0)
+def resnet18(num_classes=1000, bn0=False, dtype=jnp.float32, width=64):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, bn0, dtype, width)
 
 
-def resnet34(num_classes=1000, bn0=False):
-    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, bn0)
+def resnet34(num_classes=1000, bn0=False, dtype=jnp.float32, width=64):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, bn0, dtype, width)
 
 
-def resnet50(num_classes=1000, bn0=False):
+def resnet50(num_classes=1000, bn0=False, dtype=jnp.float32, width=64):
     """`resnet.py:187-196` — the ImageNet harness's flagship model."""
-    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, bn0)
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, bn0, dtype, width)
 
 
-def resnet101(num_classes=1000, bn0=False):
-    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, bn0)
+def resnet101(num_classes=1000, bn0=False, dtype=jnp.float32, width=64):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, bn0, dtype, width)
 
 
-def resnet152(num_classes=1000, bn0=False):
-    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, bn0)
+def resnet152(num_classes=1000, bn0=False, dtype=jnp.float32, width=64):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, bn0, dtype, width)

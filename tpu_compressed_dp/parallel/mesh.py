@@ -25,6 +25,7 @@ __all__ = [
     "replicated_sharding",
     "world_size",
     "force_host_devices",
+    "make_global_batch",
 ]
 
 DATA_AXIS = "data"
@@ -112,3 +113,23 @@ def data_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def make_global_batch(batch: dict, mesh: Mesh, axis: str = DATA_AXIS) -> dict:
+    """Assemble per-process local batches into global sharded arrays.
+
+    The multi-host equivalent of the reference's ``DistributedSampler``
+    hand-off (`dataloader.py:33`): each process holds its own slice of the
+    global batch; under SPMD the jitted step wants one global ``jax.Array``
+    whose shards live where the local data already is.  Identity when
+    single-process (the local batch *is* the global batch).
+    """
+    if jax.process_count() == 1:
+        return batch
+    sharding = NamedSharding(mesh, P(axis))
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        global_shape = (v.shape[0] * jax.process_count(),) + v.shape[1:]
+        out[k] = jax.make_array_from_process_local_data(sharding, v, global_shape)
+    return out

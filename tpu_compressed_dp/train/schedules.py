@@ -16,7 +16,13 @@ import jax.numpy as jnp
 Array = jnp.ndarray
 Schedule = Callable[[Array], Array]
 
-__all__ = ["piecewise_linear", "phase_lr_schedule", "lr_phases_to_knots"]
+__all__ = [
+    "piecewise_linear",
+    "phase_lr_schedule",
+    "lr_phases_to_knots",
+    "epoch_from_steps",
+    "phase_lr_schedule_variable_bs",
+]
 
 
 def piecewise_linear(knots: Sequence[float], vals: Sequence[float]) -> Schedule:
@@ -76,5 +82,34 @@ def phase_lr_schedule(phases: List[dict], batches_per_epoch: int) -> Schedule:
 
     def schedule(step: Array) -> Array:
         return base(jnp.asarray(step, jnp.float32) / float(batches_per_epoch))
+
+    return schedule
+
+
+def epoch_from_steps(epoch_batches: Sequence[int]) -> Schedule:
+    """Map a global step to a fractional epoch when batches-per-epoch varies.
+
+    Progressive resizing changes the batch size mid-run
+    (`train.py:60-72`: bs 512 -> 224 -> 128), so epoch ``e`` spans
+    ``epoch_batches[e]`` steps; the reference's ``Scheduler`` got fractional
+    epochs from ``(epoch, batch_num, batch_tot)`` at call time
+    (`train_imagenet_nv.py:640-645`) — here the same piecewise-affine map is
+    traced into the jitted step.
+    """
+    cum = [0.0]
+    for n in epoch_batches:
+        cum.append(cum[-1] + float(max(n, 1)))
+    epochs = [float(e) for e in range(len(cum))]
+    return piecewise_linear(cum, epochs)
+
+
+def phase_lr_schedule_variable_bs(phases: List[dict], epoch_batches: Sequence[int]) -> Schedule:
+    """Phase LR under progressive resizing: ``lr(step) = lr_by_epoch(epoch(step))``."""
+    knots, vals = lr_phases_to_knots(phases)
+    by_epoch = piecewise_linear(knots, vals)
+    to_epoch = epoch_from_steps(epoch_batches)
+
+    def schedule(step: Array) -> Array:
+        return by_epoch(to_epoch(jnp.asarray(step, jnp.float32)))
 
     return schedule

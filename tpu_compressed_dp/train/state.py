@@ -8,11 +8,13 @@ through Orbax as a unit: ``{step, params, batch_stats, opt_state, ef, rng}``.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["TrainState"]
 
@@ -36,3 +38,18 @@ class TrainState:
             ef=ef,
             rng=rng,
         )
+
+    def with_mesh_sharding(self, mesh: Mesh, axis_name: str = "data") -> "TrainState":
+        """Place the state on ``mesh``: everything replicated except the
+        per-worker EF residual, sharded on its leading device axis.  Needed
+        after a checkpoint restore (which lands arrays on one device) before
+        the shard_map'd step will accept the state."""
+        rep = NamedSharding(mesh, P())
+        dat = NamedSharding(mesh, P(axis_name))
+        placed = {
+            f.name: jax.device_put(getattr(self, f.name), rep)
+            for f in dataclasses.fields(self)
+            if f.name != "ef"
+        }
+        ef = self.ef if self.ef == () else jax.device_put(self.ef, dat)
+        return dataclasses.replace(self, ef=ef, **placed)
