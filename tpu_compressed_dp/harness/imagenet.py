@@ -256,6 +256,12 @@ def _truncate(it, n: Optional[int]):
 
 
 def run(args) -> Dict[str, float]:
+    # CLI-flag consistency first, before any I/O or device work (same refusal
+    # as the CIFAR harness; the reference silently trained dense here).
+    if args.method.lower() != "none" and args.compress == "none":
+        raise ValueError(
+            f"--method {args.method} requires --compress layerwise|entiremodel"
+        )
     distributed_init(args.coordinator, args.num_processes, args.process_id)
     mesh = make_data_mesh(args.devices)
     ndev = mesh.shape["data"]
@@ -349,6 +355,8 @@ def run(args) -> Dict[str, float]:
         pd.set_epoch(min(start_epoch, epochs - 1))
         stats_val = validate(state)
         print(f"top1 {stats_val['acc']*100:.2f} top5 {stats_val['acc5']*100:.2f}")
+        if ckpt:
+            ckpt.close()
         return stats_val
 
     for epoch in range(start_epoch, epochs):

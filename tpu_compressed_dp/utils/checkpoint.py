@@ -46,6 +46,12 @@ class Checkpointer:
             # same train step already on disk (e.g. a phase-boundary save
             # immediately after resume) — identical state, nothing to write
             return step
+        meta = dict(meta or {})
+        if self.best_metric is not None:
+            # every save carries best-so-far, so restoring from ANY latest
+            # checkpoint (incl. phase-boundary saves) keeps the improve-only
+            # gate intact
+            meta.setdefault("best_metric", self.best_metric)
         self.manager.save(
             step,
             args=ocp.args.Composite(
