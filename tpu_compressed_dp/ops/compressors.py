@@ -120,8 +120,12 @@ def top_k(g: Array, key: Optional[Array] = None, *, ratio: float) -> Array:
     n = g.shape[0]
     keep = topk_keep_count(n, ratio)
     mag = jnp.abs(g)
-    # Threshold = smallest of the `keep` largest magnitudes.
-    thresh = jax.lax.top_k(mag, keep)[0][-1]
+    # Threshold = smallest of the `keep` largest magnitudes.  Dispatches to
+    # the Pallas histogram-select kernel at gradient scale on TPU (avoids
+    # lax.top_k's full sort); exact top_k otherwise.
+    from tpu_compressed_dp.ops import kernels
+
+    thresh = kernels.topk_threshold(mag, keep)
     return jnp.where(mag >= thresh, g, 0.0)
 
 
@@ -161,6 +165,10 @@ def terngrad_levels(g: Array, key: Array) -> tuple[Array, Array]:
     (the reference would produce NaN via 0/0; SURVEY.md §2.3).
     """
     g = _flat(g)
+    from tpu_compressed_dp.ops import kernels
+
+    if kernels.use_quant_kernels(g.shape[0]):
+        return kernels.terngrad_quantize(g, key)
     mag = jnp.abs(g)
     gmax = jnp.max(mag)
     prob = jnp.where(gmax > 0, mag / jnp.where(gmax > 0, gmax, 1.0), 0.0)
@@ -187,6 +195,10 @@ def qsgd_levels(g: Array, key: Array, *, qstates: int = 255) -> tuple[Array, Arr
     zero-norm → zero-output guard (`core.py:213`) folded into the scale.
     """
     g = _flat(g)
+    from tpu_compressed_dp.ops import kernels
+
+    if kernels.use_quant_kernels(g.shape[0]):
+        return kernels.qsgd_quantize(g, key, qstates=qstates)
     norm = jnp.linalg.norm(g)
     safe_norm = jnp.where(norm > 0, norm, 1.0)
     u = jax.random.uniform(key, g.shape, dtype=g.dtype)
