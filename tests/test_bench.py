@@ -1,0 +1,41 @@
+"""Benchmark-kit tests on the virtual CPU mesh: record schema and comm
+accounting coherence (the analytic numbers the sweep reports must agree with
+the step's own comm metrics)."""
+
+from tpu_compressed_dp.bench import sweep
+
+
+def test_run_point_dense(mesh8):
+    rec = sweep.run_point(model="resnet9", method=None, batch_size=64,
+                          steps=2, warmup=1, devices=8)
+    assert rec["devices"] == 8
+    assert rec["images_per_sec"] > 0
+    assert rec["sent_frac"] == 1.0 and rec["wire_frac"] == 1.0
+    assert rec["payload_mb_per_step"] == rec["dense_mb_per_step"]
+
+
+def test_run_point_topk_layerwise(mesh8):
+    rec = sweep.run_point(model="resnet9", method="topk", ratio=0.01,
+                          granularity="layerwise", batch_size=64,
+                          steps=2, warmup=1, devices=8)
+    assert 0.005 < rec["sent_frac"] < 0.05  # ~1% + tiny-tensor rounding
+    assert rec["payload_mb_per_step"] < rec["dense_mb_per_step"] * 0.05
+    assert rec["num_collectives"] > 1
+    # ring model: 2(W-1)/W x payload at the measured rate
+    steps_per_sec = 1e3 / rec["step_ms"]
+    expect = 2 * 7 / 8 * rec["payload_mb_per_step"] / 1e3 * steps_per_sec
+    assert abs(rec["allreduce_gbps_per_chip"] - expect) < max(0.05 * expect, 0.01)
+
+
+def test_run_sweep_cli(mesh8, tmp_path, capsys):
+    args = sweep.build_parser().parse_args([
+        "--model", "resnet9", "--methods", "terngrad", "--ratios", "0.01",
+        "--granularities", "entiremodel", "--batch_size", "64",
+        "--steps", "2", "--warmup", "1", "--devices", "8",
+        "--tsv", str(tmp_path / "s.tsv"),
+    ])
+    records = sweep.run_sweep(args)
+    # dense baseline + one terngrad point
+    assert [r["method"] for r in records] == ["none", "terngrad"]
+    assert records[1]["wire_frac"] < 0.1  # 2-bit levels
+    assert (tmp_path / "s.tsv").read_text().count("\n") == 3

@@ -1,0 +1,236 @@
+"""Compression-sweep benchmark driver (the paper's Fig. 3/4 protocol).
+
+The reference validated compression variants by full training runs logged to
+TSV (`CIFAR10/dawn.py:152-153`) and measured real NIC bandwidth via
+/proc/net/dev deltas (`IMAGENET/training/meter.py:24-47`).  On TPU the wire
+payload is known analytically at trace time, so this driver measures what the
+paper plots directly:
+
+  * steady-state train-step throughput (images/sec and images/sec/chip) per
+    (method, ratio, granularity) grid point, dense baseline included;
+  * per-step gradient-sync payload (MB) and the analytic all-reduce traffic
+    per chip under a ring schedule (``2(W-1)/W × payload``), converted to
+    GB/s at the measured step rate;
+  * compression fractions (``sent_elems/dense`` and wire-bit fraction).
+
+One JSON line per grid point on stdout (progress on stderr), optional TSV.
+Convergence sweeps (accuracy-vs-epoch, the other half of Fig. 3/4) are runs
+of the training harnesses themselves — e.g.
+``python -m tpu_compressed_dp.harness.dawn --compress layerwise --method Topk
+--ratio 0.01`` — this driver covers the time/bandwidth half.
+
+Run: ``python -m tpu_compressed_dp.bench.sweep --model resnet9 --methods
+topk,randomk --ratios 0.001,0.01,0.1 --granularities layerwise,entiremodel``
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_compressed_dp.models.common import init_model, make_apply_fn
+from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
+from tpu_compressed_dp.parallel.mesh import make_data_mesh
+from tpu_compressed_dp.train.optim import SGD
+from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.train.step import make_train_step
+
+__all__ = ["run_point", "run_sweep", "main"]
+
+
+def _build_model(name: str, image_size: int, num_classes: int):
+    from tpu_compressed_dp.harness.dawn import MODELS as CIFAR_MODELS
+    from tpu_compressed_dp.harness.imagenet import ARCHS as IMAGENET_ARCHS
+
+    if name in CIFAR_MODELS:
+        return CIFAR_MODELS[name](), 32, 10
+    if name in IMAGENET_ARCHS:
+        return (
+            IMAGENET_ARCHS[name](num_classes=num_classes, dtype=jnp.bfloat16),
+            image_size,
+            num_classes,
+        )
+    raise ValueError(
+        f"unknown model {name!r}; known: {sorted(CIFAR_MODELS) + sorted(IMAGENET_ARCHS)}"
+    )
+
+
+def run_point(
+    *,
+    model: str = "resnet9",
+    method: Optional[str] = None,
+    granularity: str = "layerwise",
+    mode: str = "simulate",
+    ratio: float = 0.01,
+    qstates: int = 255,
+    error_feedback: bool = False,
+    batch_size: int = 512,
+    image_size: int = 128,
+    num_classes: int = 1000,
+    steps: int = 30,
+    warmup: int = 3,
+    devices: Optional[int] = None,
+) -> Dict[str, float]:
+    """Measure one grid point; returns a flat record (also JSON-serialisable)."""
+    mesh = make_data_mesh(devices)
+    ndev = mesh.shape["data"]
+    bs = batch_size if batch_size % ndev == 0 else (batch_size // ndev + 1) * ndev
+
+    module, sz, ncls = _build_model(model, image_size, num_classes)
+    params, stats = init_model(
+        module, jax.random.key(0), jnp.zeros((1, sz, sz, 3), jnp.float32)
+    )
+    apply_fn = make_apply_fn(module)
+
+    opt = SGD(lr=0.01, momentum=0.9, weight_decay=5e-4)
+    cfg = CompressionConfig(
+        method=method, granularity=granularity, mode=mode, ratio=ratio,
+        qstates=qstates, error_feedback=error_feedback,
+    )
+    state = TrainState.create(
+        params, stats, opt.init(params), init_ef_state(params, cfg, ndev),
+        jax.random.key(1),
+    )
+    train_step = make_train_step(apply_fn, opt, cfg, mesh, grad_scale=1.0)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(rng.standard_normal((bs, sz, sz, 3), dtype=np.float32)),
+        "target": jnp.asarray(rng.integers(0, ncls, size=(bs,), dtype=np.int32)),
+    }
+
+    # Barrier = value fetch: on remote-tunneled backends (axon)
+    # block_until_ready returns before execution finishes, so every timing
+    # boundary must force an actual transfer.
+    def sync(m):
+        return float(jax.tree.leaves(m)[0])
+
+    # Warmup is time-based, not step-based (a freshly-attached chip ramps for
+    # several seconds), with a barrier per burst so no backlog leaks into the
+    # timed region.  The CPU backend has no ramp — plain step-count warmup.
+    min_warm_s = 2.0 if jax.default_backend() != "cpu" else 0.0
+    t0 = time.perf_counter()
+    done = 0
+    while done < warmup or time.perf_counter() - t0 < min_warm_s:
+        for _ in range(8 if min_warm_s else 1):
+            state, metrics = train_step(state, batch)
+            done += 1
+        sync(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, batch)
+    metrics = jax.device_get(metrics)  # true barrier: waits for the chain
+    dt = time.perf_counter() - t0
+
+    images_per_sec = steps * bs / dt
+    record: Dict[str, float] = {
+        "model": model,
+        "method": method or "none",
+        "granularity": granularity,
+        "mode": mode,
+        "ratio": ratio,
+        "error_feedback": bool(error_feedback),
+        "devices": ndev,
+        "batch": bs,
+        "image_size": sz,
+        "step_ms": round(dt / steps * 1e3, 3),
+        "images_per_sec": round(images_per_sec, 1),
+        "images_per_sec_per_chip": round(images_per_sec / ndev, 1),
+    }
+    if "comm/sent_bits" in metrics:
+        payload_mb = float(metrics["comm/sent_bits"]) / 8 / 1e6  # per worker, per step
+        dense_mb = float(metrics["comm/dense_elems"]) * 4 / 1e6
+        # ring all-reduce moves 2(W-1)/W of the payload through each chip's links
+        ring = 2 * (ndev - 1) / max(ndev, 1)
+        record.update({
+            "payload_mb_per_step": round(payload_mb, 4),
+            "dense_mb_per_step": round(dense_mb, 4),
+            "sent_frac": round(float(metrics["comm/sent_elems"])
+                               / max(float(metrics["comm/dense_elems"]), 1.0), 5),
+            "wire_frac": round(float(metrics["comm/sent_bits"])
+                               / (32.0 * max(float(metrics["comm/dense_elems"]), 1.0)), 5),
+            "allreduce_gbps_per_chip": round(
+                ring * payload_mb / 1e3 * (steps / dt), 3),
+            "dense_allreduce_gbps_per_chip": round(
+                ring * dense_mb / 1e3 * (steps / dt), 3),
+            "num_collectives": float(metrics["comm/num_collectives"]),
+        })
+    return record
+
+
+def run_sweep(args) -> List[Dict[str, float]]:
+    methods = [m.strip() for m in args.methods.split(",") if m.strip()]
+    ratios = [float(r) for r in args.ratios.split(",")]
+    grans = [g.strip() for g in args.granularities.split(",") if g.strip()]
+    records = []
+
+    def emit(rec):
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    common = dict(
+        model=args.model, batch_size=args.batch_size, image_size=args.image_size,
+        num_classes=args.num_classes, steps=args.steps, warmup=args.warmup,
+        devices=args.devices, mode=args.mode, qstates=args.qstates,
+        error_feedback=args.error_feedback,
+    )
+    print(f"# dense baseline: {args.model}", file=sys.stderr)
+    emit(run_point(method=None, **{**common, "error_feedback": False}))
+    for method, gran in itertools.product(methods, grans):
+        pts = ratios if method in ("topk", "randomk") else [None]
+        for ratio in pts:
+            label = f"{method}/{gran}" + (f"/k={ratio}" if ratio is not None else "")
+            print(f"# {label}", file=sys.stderr)
+            emit(run_point(method=method, granularity=gran,
+                           ratio=ratio if ratio is not None else 0.01, **common))
+    if args.tsv:
+        import os
+
+        os.makedirs(os.path.dirname(os.path.abspath(args.tsv)), exist_ok=True)
+        keys = sorted({k for r in records for k in r})
+        with open(args.tsv, "w") as f:
+            f.write("\t".join(keys) + "\n")
+            for r in records:
+                f.write("\t".join(str(r.get(k, "")) for k in keys) + "\n")
+        print(f"# wrote {args.tsv}", file=sys.stderr)
+    return records
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="compression sweep benchmark")
+    p.add_argument("--model", default="resnet9")
+    p.add_argument("--methods", default="topk,randomk",
+                   help="comma list; full set: topk,randomk,thresholdv,"
+                        "adaptive_threshold,terngrad,qsgd")
+    p.add_argument("--ratios", default="0.001,0.01,0.1",
+                   help="k values for topk/randomk (paper: 0.1%%,1%%,10%%)")
+    p.add_argument("--granularities", default="layerwise,entiremodel")
+    p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
+    p.add_argument("--qstates", type=int, default=255)
+    p.add_argument("--error_feedback", action="store_true")
+    p.add_argument("--batch_size", type=int, default=512)
+    p.add_argument("--image_size", type=int, default=128,
+                   help="input size for the ImageNet archs (CIFAR models fix 32)")
+    p.add_argument("--num_classes", type=int, default=1000)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--tsv", type=str, default=None)
+    return p
+
+
+def main(argv: Optional[list] = None):
+    return run_sweep(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -119,6 +119,12 @@ def _count_ge_kernel(lo_ref, hi_ref, x_ref, counts_ref):
     counts_ref[0, :] += jnp.sum(cmp.astype(jnp.float32), axis=(0, 1))
 
 
+def _vma(x: Array):
+    """Varying-mesh-axes of ``x`` — must be propagated onto pallas_call
+    out_shapes when the kernel runs on device-varying data inside shard_map."""
+    return getattr(jax.typeof(x), "vma", frozenset())
+
+
 def _topk_threshold_pallas(
     mag: Array, keep: int, *, rounds: int = 4, interpret: bool = False
 ) -> Array:
@@ -134,7 +140,7 @@ def _topk_threshold_pallas(
             pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, _LANES), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((1, _LANES), jnp.float32, vma=_vma(mag)),
         interpret=interpret,
     )
 
@@ -161,10 +167,15 @@ def _topk_threshold_pallas(
         )
         return new_lo, new_hi, new_above
 
-    lo, _, _ = jax.lax.fori_loop(
-        0, rounds, round_body,
-        (jnp.float32(0.0), hi0.astype(jnp.float32), jnp.float32(0.0)),
-    )
+    # the carry becomes device-varying after round 1 (counts derive from the
+    # varying magnitudes) — pcast the replicated init so loop types match
+    vma = tuple(_vma(mag))
+    init = (jnp.float32(0.0), hi0.astype(jnp.float32), jnp.float32(0.0))
+    if vma:
+        init = tuple(
+            jax.lax.pcast(v, vma, to="varying") if not _vma(v) else v for v in init
+        )
+    lo, _, _ = jax.lax.fori_loop(0, rounds, round_body, init)
     return lo
 
 
@@ -197,20 +208,26 @@ def _uniform_from_bits(shape) -> Array:
     return top24.astype(jnp.float32) * (1.0 / (1 << 24))
 
 
+def _sign(x: Array) -> Array:
+    # jnp.sign's Mosaic lowering emits an unsupported `pvary` when traced
+    # under shard_map's varying-axes tracking; select-based sign lowers clean
+    return jnp.where(x > 0, 1.0, 0.0) - jnp.where(x < 0, 1.0, 0.0)
+
+
 def _qsgd_kernel(qstates: int, seed_ref, inv_norm_ref, x_ref, out_ref):
     pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
     x = x_ref[:]
     u = _uniform_from_bits(x.shape)
     levels = jnp.floor(jnp.abs(x) * inv_norm_ref[0, 0] * qstates + u)
-    out_ref[:] = (jnp.sign(x) * levels).astype(jnp.int16)
+    out_ref[:] = (_sign(x) * levels).astype(jnp.int16)
 
 
 def _terngrad_kernel(seed_ref, inv_max_ref, x_ref, out_ref):
     pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
     x = x_ref[:]
     u = _uniform_from_bits(x.shape)
-    keep = u < jnp.abs(x) * inv_max_ref[0, 0]
-    out_ref[:] = (jnp.sign(x) * keep).astype(jnp.int8)
+    keep = (u < jnp.abs(x) * inv_max_ref[0, 0]).astype(jnp.float32)
+    out_ref[:] = (_sign(x) * keep).astype(jnp.int8)
 
 
 def _run_quant(kernel, out_dtype, flat: Array, inv_scale: Array, seed: Array,
@@ -226,7 +243,7 @@ def _run_quant(kernel, out_dtype, flat: Array, inv_scale: Array, seed: Array,
             pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(x2d.shape, out_dtype),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, out_dtype, vma=_vma(flat)),
         # TPU-semantics interpreter: the stock HLO interpreter has no
         # prng_seed/prng_random_bits (NB: its PRNG is a zero stub — dither
         # u == 0 under interpretation; see tests/test_kernels.py)

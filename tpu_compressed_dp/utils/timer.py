@@ -16,9 +16,16 @@ __all__ = ["Timer", "device_sync"]
 
 
 def device_sync() -> None:
-    """Block until all enqueued device work is complete."""
-    for d in jax.live_arrays():
-        d.block_until_ready()
+    """Block until all enqueued device work is complete.
+
+    Implemented as a value fetch of a fresh sentinel computation: device
+    queues are FIFO, so fetching the sentinel drains everything enqueued
+    before it.  (``block_until_ready`` alone is not a reliable barrier on
+    remote-tunneled backends — observed on axon to return pre-completion.)
+    """
+    import jax.numpy as jnp
+
+    jax.device_get(jnp.zeros(()) + 0.0)
 
 
 class Timer:
