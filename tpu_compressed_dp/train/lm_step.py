@@ -1,0 +1,234 @@
+"""Jitted LM pretrain step over a (data, seq, tensor) mesh.
+
+Composes the three parallelism axes the Llama stretch config needs
+(BASELINE.json; none exist in the reference, SURVEY.md §2.2):
+
+  * ``data`` — batch sharding; gradients compress-then-psum across it (and
+    across ``seq``), via the same sync engine as the CNN harnesses
+    (:func:`tpu_compressed_dp.parallel.dp.make_grad_sync` — layerwise or
+    entire-model, all six methods, simulate or wire, error feedback).
+  * ``seq`` — sequence sharding; attention runs as a ring
+    (:mod:`tpu_compressed_dp.ops.ring_attention`).  A (data, seq) pair is one
+    "compression worker": each holds a distinct micro-slice of tokens, so the
+    gradient reduction spans the combined ``("data", "seq")`` axes.
+  * ``tensor`` — megatron-style sharded layers inside the model
+    (:mod:`tpu_compressed_dp.models.transformer`); TP-internal reductions
+    (attention/MLP output psums, vocab-parallel loss, replicated-param
+    cotangents) are exact and uncompressed, mirroring how the reference
+    compressed only the *data-parallel* gradient exchange.
+
+Everything is one ``shard_map`` over the full mesh: tensor-sharded params
+arrive as local shards, replicated params are marked device-varying over
+(data, seq) (same pcast trick as train/step.py) so the compressed sync — not
+shard_map's AD — owns the data-axis reduction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tpu_compressed_dp.models.transformer import (
+    LlamaConfig,
+    apply_llama,
+    param_specs,
+    vocab_parallel_xent,
+)
+from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grad_sync
+from tpu_compressed_dp.train.optim import SGD
+from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.train.step import optimizer_lr
+
+Array = jax.Array
+
+__all__ = ["make_lm_train_step", "init_lm_ef_state", "lm_state_specs", "make_lm_mesh"]
+
+LM_AXES = ("data", "seq", "tensor")
+
+
+def make_lm_mesh(data: int, seq: int = 1, tensor: int = 1) -> Mesh:
+    from tpu_compressed_dp.parallel.mesh import make_mesh
+
+    return make_mesh((data, seq, tensor), LM_AXES)
+
+
+def init_lm_ef_state(cfg: LlamaConfig, params: Any, comp: CompressionConfig,
+                     mesh: Mesh) -> Any:
+    """EF residual with a leading (data*seq) worker axis; tensor-sharded dims
+    follow the param's own sharding (each tensor shard keeps its own
+    residual slice)."""
+    if not comp.error_feedback:
+        return ()
+    workers = mesh.shape["data"] * mesh.shape["seq"]
+    return jax.tree.map(
+        lambda p: jnp.zeros((workers,) + p.shape, jnp.float32), params
+    )
+
+
+def _ef_specs(pspecs: Any) -> Any:
+    return jax.tree.map(
+        lambda s: P(("data", "seq"), *s), pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def lm_state_specs(cfg: LlamaConfig, comp: CompressionConfig) -> TrainState:
+    """PartitionSpec pytree for the LM TrainState (shard_map in/out specs)."""
+    pspecs = param_specs(cfg)
+    return TrainState(
+        step=P(),
+        params=pspecs,
+        batch_stats=P(),
+        opt_state={"momentum": pspecs},
+        ef=_ef_specs(pspecs) if comp.error_feedback else P(),
+        rng=P(),
+    )
+
+
+def make_lm_train_step(
+    cfg: LlamaConfig,
+    optimizer: SGD,
+    comp_cfg: CompressionConfig,
+    mesh: Mesh,
+    *,
+    donate: bool = True,
+):
+    """Build ``train_step(state, batch) -> (state, metrics)``.
+
+    ``batch``: ``{'input': [B, T] int32, 'target': [B, T] int32}``, ``B``
+    divisible by the data axis, ``T`` by the seq axis.
+    """
+    cfg.validate_mesh(mesh.shape["tensor"])
+    sync_axes = ("data", "seq")
+    grad_sync = make_grad_sync(comp_cfg, axis_name=sync_axes)
+    n_workers = mesh.shape["data"] * mesh.shape["seq"]
+
+    # Compression masks are data-dependent (top-k threshold) — flattening
+    # tensor-SHARDED leaves together with tensor-REPLICATED ones would give
+    # each tensor shard a different mask over the replicated sections and
+    # silently de-synchronise replicated params across the tensor axis.
+    # Split the tree: the replicated group's inputs (and hence masks) are
+    # identical on every tensor shard (their grads are already tensor-psummed
+    # by shard_map AD), so its sync stays consistent; the sharded group syncs
+    # each shard independently over (data, seq).
+    pspec_leaves = jax.tree.leaves(
+        param_specs(cfg), is_leaf=lambda x: isinstance(x, P)
+    )
+    is_sharded = [any(ax == "tensor" for ax in spec) for spec in pspec_leaves]
+
+    def split(tree):
+        leaves = jax.tree.leaves(tree)
+        return (
+            [l for l, s in zip(leaves, is_sharded) if not s],
+            [l for l, s in zip(leaves, is_sharded) if s],
+        )
+
+    def merge(treedef_like, rep, sh):
+        rep_it, sh_it = iter(rep), iter(sh)
+        leaves = [next(sh_it) if s else next(rep_it) for s in is_sharded]
+        return jax.tree.unflatten(jax.tree.structure(treedef_like), leaves)
+
+    def local_step(state: TrainState, x: Array, y: Array):
+        comp_key = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            logits = apply_llama(cfg, params, x, tensor_axis="tensor",
+                                 seq_axis="seq")
+            return vocab_parallel_xent(logits, y, tensor_axis="tensor")
+
+        varying = jax.tree.map(
+            lambda p: jax.lax.pcast(p, sync_axes, to="varying"), state.params
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(varying)
+
+        ef_local = jax.tree.map(lambda e: e[0], state.ef)
+        g_rep, g_sh = split(grads)
+        use_ef = comp_cfg.error_feedback
+        e_rep, e_sh = split(ef_local) if use_ef else ((), ())
+        key_rep, key_sh = jax.random.split(comp_key)
+        sync_rep, ef_rep, comm_rep = grad_sync(g_rep, e_rep if use_ef else (), key_rep)
+        sync_sh, ef_sh, comm_sh = grad_sync(g_sh, e_sh if use_ef else (), key_sh)
+        synced = merge(grads, sync_rep, sync_sh)
+        new_ef = merge(ef_local, ef_rep, ef_sh) if use_ef else ()
+        # model-wide totals: the sharded group's stats differ per tensor shard
+        # (each shard is its own payload) — sum them over the tensor axis
+        comm = {
+            k: comm_rep[k] + jax.lax.psum(comm_sh[k], "tensor")
+            for k in comm_rep
+        }
+        new_ef = jax.tree.map(lambda e: e[None], new_ef)
+
+        new_step = state.step + 1
+        new_params, new_opt = optimizer.apply(state.params, synced,
+                                              state.opt_state, new_step)
+        ntok = jnp.asarray(x.shape[0] * x.shape[1], jnp.float32)
+        metrics = {
+            "loss": jax.lax.pmean(loss, sync_axes),
+            "tokens": jax.lax.psum(ntok, sync_axes),
+            "lr": optimizer_lr(optimizer, new_step),
+        }
+        for k, v in comm.items():
+            metrics[f"comm/{k}"] = jax.lax.pmean(v, sync_axes)
+
+        return dataclasses.replace(
+            state, step=new_step, params=new_params, opt_state=new_opt,
+            ef=new_ef,
+        ), metrics
+
+    state_spec = lm_state_specs(cfg, comp_cfg)
+    data_spec = P("data", "seq")
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec, data_spec, data_spec),
+        out_specs=(state_spec, P()),
+    )
+    jitted = partial(jax.jit, donate_argnums=(0,) if donate else ())(
+        lambda state, x, y: sharded(state, x, y)
+    )
+
+    def train_step(state: TrainState, batch: Dict[str, Array]):
+        for leaf in jax.tree.leaves(state.ef):
+            if leaf.ndim < 1 or leaf.shape[0] != n_workers:
+                raise ValueError(
+                    f"LM EF residual needs leading axis {n_workers} "
+                    f"(data x seq workers); got {leaf.shape} — build with "
+                    "init_lm_ef_state(cfg, params, comp, mesh)"
+                )
+        return jitted(state, batch["input"], batch["target"])
+
+    return train_step
+
+
+def make_lm_eval_step(cfg: LlamaConfig, mesh: Mesh):
+    """``eval_step(state, batch) -> {'loss': mean nll, 'tokens': count}``."""
+    cfg.validate_mesh(mesh.shape["tensor"])
+
+    def local_eval(params, x: Array, y: Array):
+        logits = apply_llama(cfg, params, x, tensor_axis="tensor", seq_axis="seq")
+        loss = vocab_parallel_xent(logits, y, tensor_axis="tensor")
+        return {
+            "loss": jax.lax.pmean(loss, ("data", "seq")),
+            "tokens": jax.lax.psum(
+                jnp.asarray(x.shape[0] * x.shape[1], jnp.float32), ("data", "seq")
+            ),
+        }
+
+    pspecs = param_specs(cfg)
+    sharded = shard_map(
+        local_eval, mesh=mesh,
+        in_specs=(pspecs, P("data", "seq"), P("data", "seq")),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def eval_step(state: TrainState, batch: Dict[str, Array]):
+        return sharded(state.params, batch["input"], batch["target"])
+
+    return eval_step
