@@ -1,0 +1,197 @@
+"""Llama pretrain harness — the BASELINE.json stretch config driver.
+
+No reference equivalent exists (the reference trains CNNs only); the flag
+surface follows the CNN harnesses where concepts coincide (compression
+config, checkpointing, logging) and adds the mesh/model axes.  The headline
+configuration is ``--preset llama3_8b --compress entiremodel --method topk``:
+entire-model Top-K gradient compression over ICI, with tensor and sequence
+parallelism inside the chip mesh.
+
+Smoke run (CPU, 8 virtual devices):
+  ``python -m tpu_compressed_dp.harness.lm --preset tiny --dp 2 --sp 2
+  --tp 2 --steps 20 --seq_len 64 --global_batch 8``
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_compressed_dp.data import lm as lm_data
+from tpu_compressed_dp.models import transformer as tf
+from tpu_compressed_dp.parallel.dp import CompressionConfig
+from tpu_compressed_dp.parallel.mesh import distributed_init
+from tpu_compressed_dp.train.lm_step import (
+    init_lm_ef_state,
+    make_lm_mesh,
+    make_lm_train_step,
+)
+from tpu_compressed_dp.train.optim import SGD
+from tpu_compressed_dp.train.schedules import piecewise_linear
+from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.utils.checkpoint import Checkpointer
+from tpu_compressed_dp.utils.loggers import TableLogger
+
+PRESETS = {
+    "tiny": tf.tiny_llama,
+    "llama3_8b": tf.llama3_8b,
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Llama pretrain, compressed-DP over (data, seq, tensor) mesh")
+    p.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
+    p.add_argument("--vocab", type=int, default=None)
+    p.add_argument("--dim", type=int, default=None)
+    p.add_argument("--layers", type=int, default=None)
+    p.add_argument("--heads", type=int, default=None)
+    p.add_argument("--kv_heads", type=int, default=None)
+    p.add_argument("--ffn", type=int, default=None)
+    p.add_argument("--fp32", action="store_true", help="disable bf16 compute")
+    # mesh
+    p.add_argument("--dp", type=int, default=None, help="data axis size (default: all devices)")
+    p.add_argument("--sp", type=int, default=1, help="sequence axis size")
+    p.add_argument("--tp", type=int, default=1, help="tensor axis size")
+    # data/schedule
+    p.add_argument("--corpus", type=str, default=None, help="byte-level text file; default synthetic")
+    p.add_argument("--seq_len", type=int, default=512)
+    p.add_argument("--global_batch", type=int, default=32)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--warmup_steps", type=int, default=10)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight_decay", type=float, default=0.0)
+    # compression (same surface as the CNN harnesses)
+    p.add_argument("--compress", "-c", default="none", choices=["none", "layerwise", "entiremodel"])
+    p.add_argument("--method", default="none")
+    p.add_argument("--ratio", "-K", type=float, default=0.01)
+    p.add_argument("--threshold", "-V", type=float, default=0.001)
+    p.add_argument("--qstates", "-Q", type=int, default=255)
+    p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
+    p.add_argument("--error_feedback", action="store_true")
+    # plumbing
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log_every", type=int, default=10)
+    p.add_argument("--checkpoint_dir", type=str, default=None)
+    p.add_argument("--resume", type=str, default=None)
+    p.add_argument("--coordinator", type=str, default=None)
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    return p
+
+
+def build_config(args) -> tf.LlamaConfig:
+    import dataclasses
+
+    cfg = PRESETS[args.preset]()
+    overrides = {}
+    for field, arg in [("vocab_size", args.vocab), ("dim", args.dim),
+                       ("n_layers", args.layers), ("n_heads", args.heads),
+                       ("n_kv_heads", args.kv_heads), ("ffn_hidden", args.ffn)]:
+        if arg is not None:
+            overrides[field] = arg
+    if args.fp32:
+        overrides["dtype"] = jnp.float32
+    return dataclasses.replace(cfg, **overrides)
+
+
+def run(args) -> Dict[str, float]:
+    if args.method.lower() != "none" and args.compress == "none":
+        raise ValueError(f"--method {args.method} requires --compress layerwise|entiremodel")
+    distributed_init(args.coordinator, args.num_processes, args.process_id)
+    ndev = len(jax.devices())
+    dp = args.dp if args.dp is not None else ndev // (args.sp * args.tp)
+    mesh = make_lm_mesh(dp, args.sp, args.tp)
+    cfg = build_config(args)
+    cfg.validate_mesh(args.tp)
+
+    if args.global_batch % dp:
+        raise ValueError(f"--global_batch {args.global_batch} must divide by dp={dp}")
+    if args.seq_len % args.sp:
+        raise ValueError(f"--seq_len {args.seq_len} must divide by sp={args.sp}")
+
+    if args.corpus:
+        ds = lm_data.ByteCorpus(args.corpus, args.seq_len, args.global_batch,
+                                seed=args.seed)
+        if ds.vocab != cfg.vocab_size:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, vocab_size=ds.vocab)
+    else:
+        ds = lm_data.SyntheticTokens(cfg.vocab_size, args.seq_len,
+                                     args.global_batch, seed=args.seed)
+
+    params = tf.init_llama(cfg, jax.random.key(args.seed))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    sched = piecewise_linear(
+        [0, max(args.warmup_steps, 1), max(args.steps, args.warmup_steps + 1)],
+        [0.0, args.lr, args.lr * 0.1],
+    )
+    opt = SGD(lr=sched, momentum=args.momentum, weight_decay=args.weight_decay)
+    comp = CompressionConfig(
+        method=None if args.compress == "none" or args.method.lower() == "none" else args.method,
+        granularity=args.compress if args.compress != "none" else "layerwise",
+        mode=args.mode, ratio=args.ratio, threshold=args.threshold,
+        qstates=args.qstates, error_feedback=args.error_feedback,
+    )
+    state = TrainState.create(
+        params, {}, opt.init(params), init_lm_ef_state(cfg, params, comp, mesh),
+        jax.random.key(args.seed + 1),
+    )
+    ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+    if args.resume:
+        from tpu_compressed_dp.train.lm_step import place_lm_state
+
+        restore = Checkpointer(args.resume)
+        state, meta = restore.restore(state)
+        restore.close()
+        state = place_lm_state(state, cfg, comp, mesh)
+        print(f"resumed step {int(state.step)}")
+
+    train_step = make_lm_train_step(cfg, opt, comp, mesh)
+    print(f"params={n_params/1e6:.1f}M mesh=dp{dp}xsp{args.sp}xtp{args.tp} "
+          f"seq={args.seq_len} batch={args.global_batch} "
+          f"method={comp.method or 'dense'}/{comp.granularity}/{comp.mode}")
+
+    table = TableLogger()
+    t0 = time.time()
+    tokens_done = 0.0
+    summary: Dict[str, float] = {}
+    start = int(state.step)
+    for step_i in range(start, args.steps):
+        batch = ds.batch(step_i)
+        state, metrics = train_step(
+            state, {k: jnp.asarray(v) for k, v in batch.items()})
+        if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
+            m = jax.device_get(metrics)
+            tokens_done = (step_i + 1 - start) * args.global_batch * args.seq_len
+            dt = time.time() - t0
+            summary = {
+                "step": step_i + 1,
+                "loss": float(m["loss"]),
+                "lr": float(m["lr"]),
+                "tok/s": round(tokens_done / dt, 1),
+            }
+            if "comm/sent_elems" in m:
+                summary["sent frac"] = float(m["comm/sent_elems"]) / max(
+                    float(m["comm/dense_elems"]), 1.0)
+                summary["wire frac"] = float(m["comm/sent_bits"]) / (
+                    32.0 * max(float(m["comm/dense_elems"]), 1.0))
+            table.append(summary)
+    if ckpt:
+        ckpt.save(state, {"step": int(state.step)})
+        ckpt.close()
+    return summary
+
+
+def main(argv: Optional[list] = None):
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()
