@@ -1,0 +1,100 @@
+"""Observability: tensorboard/file loggers, meters, profiler wiring."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.utils import meters
+from tpu_compressed_dp.utils.loggers import FileLogger, NoOp, TensorboardLogger
+
+
+class TestTensorboardLogger:
+    def test_writes_scalars_and_json(self, tmp_path):
+        tb = TensorboardLogger(str(tmp_path / "tb"))
+        tb.update_examples_count(512)
+        tb.log_scalar("losses/train_loss", 1.5)
+        tb.update_examples_count(512)
+        tb.log_scalar("losses/train_loss", 1.2)
+        tb.log_metrics({"net/x": 3.0, "skip": "str"})
+        tb.close()
+        data = json.load(open(tmp_path / "tb" / "scalars.json"))
+        assert data["losses/train_loss"] == [[512, 1.5], [1024, 1.2]]
+        assert data["net/x"] == [[1024, 3.0]]
+        assert any(f.startswith("events") for f in os.listdir(tmp_path / "tb"))
+
+    def test_non_master_is_noop(self, tmp_path):
+        tb = TensorboardLogger(str(tmp_path / "tb2"), is_master=False)
+        assert isinstance(tb, NoOp)
+        tb.log_scalar("x", 1.0)  # absorbs anything
+        tb.close()
+        assert not (tmp_path / "tb2").exists()
+
+    def test_disabled_without_dir(self):
+        assert isinstance(TensorboardLogger(None), NoOp)
+
+
+class TestFileLogger:
+    def test_level_routing(self, tmp_path, capsys):
+        log = FileLogger(str(tmp_path), rank=3)
+        log.debug("dbg")
+        log.info("inf")
+        log.event("~~1\t0.1\t90\t95")
+        verbose = (tmp_path / "verbose.log").read_text()
+        event = (tmp_path / "event.log").read_text()
+        debug = (tmp_path / "debug.log").read_text()
+        assert "inf" in verbose and "~~1" in verbose and "dbg" not in verbose
+        assert "~~1" in event and "inf" not in event
+        assert "dbg" in debug and "DEBUG" in debug
+        assert "3: inf" in capsys.readouterr().out  # rank-prefixed console
+
+    def test_non_master_console_only(self, tmp_path):
+        FileLogger(None, rank=1, is_master=False).info("x")
+        assert not os.listdir(tmp_path)
+
+
+class TestMeters:
+    def test_network_bytes_reads_proc(self):
+        recv, transmit = meters.network_bytes()
+        assert recv >= 0 and transmit >= 0
+
+    def test_network_meter_interval(self):
+        m = meters.NetworkMeter()
+        rg, tg = m.update_bandwidth()
+        assert rg >= 0 and tg >= 0
+
+    def test_time_meter(self):
+        m = meters.TimeMeter()
+        m.batch_loaded()
+        m.batch_dispatched()
+        s = m.summary()
+        assert s["data ms/batch"] >= 0 and s["dispatch ms/batch"] >= 0
+
+    def test_comm_meter(self):
+        m = meters.CommMeter(world=8)
+        m.update({"comm/sent_bits": 8e6, "comm/dense_elems": 1e6})
+        m.update({"comm/sent_bits": 8e6, "comm/dense_elems": 1e6})
+        out = m.gbps()
+        assert out["net/payload_mb_per_step"] == pytest.approx(1.0)
+        assert out["net/compression_frac"] == pytest.approx(0.25)
+        assert out["net/allreduce_gbps_per_chip"] > 0
+
+
+def test_imagenet_harness_tensorboard_integration(tmp_path):
+    from tpu_compressed_dp.harness import imagenet as h
+
+    h.main([
+        "--synthetic", "--synthetic_n", "64", "--num_classes", "4",
+        "--arch", "resnet18", "--width", "8", "--short_epoch", "--workers", "2",
+        "--compress", "layerwise", "--method", "randomk", "--ratio", "0.1",
+        "--logdir", str(tmp_path), "--tensorboard",
+    ])
+    scalars = json.load(open(tmp_path / "tb" / "scalars.json"))
+    assert "losses/top5" in scalars and "net/payload_mb_per_step" in scalars
+    assert len(scalars["losses/train_loss"]) == 3  # smoke schedule: 3 epochs
+    # x-axis is cumulative examples
+    xs = [p[0] for p in scalars["losses/train_loss"]]
+    assert xs == sorted(xs) and xs[0] > 0
+    assert "~~0" in (tmp_path / "event.log").read_text()
+    assert (tmp_path / "logs.tsv").exists()

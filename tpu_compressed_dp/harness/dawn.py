@@ -22,6 +22,7 @@ Run: ``python -m tpu_compressed_dp.harness.dawn --synthetic --epochs 2``
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Optional
 
 import jax
@@ -88,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--channels_scale", type=float, default=1.0,
                    help="width multiplier for the graph-family nets")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tensorboard", action="store_true",
+                   help="write tensorboard scalars under <log_dir>/tb")
+    p.add_argument("--profile_epoch", type=int, default=None,
+                   help="jax.profiler-trace this epoch to <log_dir>/profile")
     # multi-host rendezvous (the reference's --master_address/--rank/--world_size)
     p.add_argument("--coordinator", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)
@@ -179,12 +184,22 @@ def run(args) -> dict:
     # work) and its end-of-epoch device_get blocks on everything outstanding —
     # the role torch.cuda.synchronize played in `dawn.py:129`.
     timer = Timer()
+    from tpu_compressed_dp.utils.loggers import TensorboardLogger
+
+    tb = TensorboardLogger(
+        os.path.join(args.log_dir, "tb") if args.log_dir and args.tensorboard else None
+    )
     summary = {}
     for epoch in range(epochs):
+        profiling = args.profile_epoch == epoch and args.log_dir
+        if profiling:
+            jax.profiler.start_trace(os.path.join(args.log_dir, "profile"))
         state, epoch_stats = train_epoch(
             train_step, eval_step, state, train_batches, test_batches, timer, bs,
             test_time_in_total=False,
         )
+        if profiling:
+            jax.profiler.stop_trace()
         summary = {
             "epoch": epoch + 1,
             "lr": float(sched((epoch + 1))),
@@ -193,8 +208,13 @@ def run(args) -> dict:
         }
         table.append(summary)
         tsv.append(summary)
+        tb.update_examples_count(len(train_batches) * bs)
+        tb.log_metrics({f"losses/{k}": v for k, v in summary.items()
+                        if k in ("train loss", "test loss", "train acc", "test acc")})
+        tb.log_scalar("times/epoch_seconds", summary["train time"])
     if args.log_dir:
         tsv.save(args.log_dir)
+    tb.close()
     return summary
 
 

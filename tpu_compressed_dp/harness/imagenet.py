@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -56,7 +57,13 @@ from tpu_compressed_dp.train.schedules import phase_lr_schedule_variable_bs
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import make_eval_step, make_train_step
 from tpu_compressed_dp.utils.checkpoint import Checkpointer
-from tpu_compressed_dp.utils.loggers import TableLogger, TSVLogger
+from tpu_compressed_dp.utils.loggers import (
+    FileLogger,
+    TableLogger,
+    TensorboardLogger,
+    TSVLogger,
+)
+from tpu_compressed_dp.utils.meters import NetworkMeter
 from tpu_compressed_dp.utils.timer import Timer
 
 ARCHS = {
@@ -238,6 +245,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--synthetic_n", type=int, default=512)
     p.add_argument("--logdir", type=str, default=None)
+    p.add_argument("--tensorboard", action="store_true",
+                   help="write tensorboard scalars under <logdir>/tb")
+    p.add_argument("--profile_epoch", type=int, default=None,
+                   help="jax.profiler-trace this epoch to <logdir>/profile")
     # multi-host rendezvous
     p.add_argument("--coordinator", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)
@@ -349,6 +360,14 @@ def run(args) -> Dict[str, float]:
     timer = Timer()
     t0 = time.time()
     summary: Dict[str, float] = {}
+    is_master = jax.process_index() == 0
+    tb = TensorboardLogger(
+        os.path.join(args.logdir, "tb") if args.logdir and args.tensorboard else None,
+        is_master=is_master,
+    )
+    flog = FileLogger(args.logdir if is_master else None, rank=jax.process_index(),
+                      is_master=is_master)
+    net_meter = NetworkMeter()
 
     if args.evaluate:
         # a finished run evaluates at its final phase's resolution
@@ -369,14 +388,19 @@ def run(args) -> Dict[str, float]:
             for b in _truncate(pd.train_loader, 10 if args.short_epoch else None):
                 yield make_global_batch(b, mesh)
 
+        profiling = args.profile_epoch == epoch and args.logdir
+        if profiling:
+            jax.profiler.start_trace(os.path.join(args.logdir, "profile"))
         state, acc = run_train_epoch(train_step, state, train_batches())
+        if profiling:
+            jax.profiler.stop_trace()
         train_time = timer()
         val_stats = validate(state)
         timer()
         top1, top5 = val_stats["acc"] * 100, val_stats["acc5"] * 100
         hours = (time.time() - t0) / 3600
         # `~~epoch\thours\ttop1\ttop5` event line (`train_imagenet_nv.py:232,243`)
-        print(f"~~{epoch}\t{hours:.5f}\t\t{top1:.3f}\t\t{top5:.3f}\n")
+        flog.event(f"~~{epoch}\t{hours:.5f}\t\t{top1:.3f}\t\t{top5:.3f}\n")
         summary = {
             "epoch": epoch, "train time": train_time,
             "train loss": acc.mean("loss"),
@@ -387,11 +411,34 @@ def run(args) -> Dict[str, float]:
         summary.update(comm_summary(acc))
         table.append(summary)
         tsv.append(summary)
+        # tensorboard: x-axis = cumulative examples (`logger.py:24-34`);
+        # namespaces mirror the reference (losses/ times/ net/)
+        examples = int(acc.sums.get("count", 0.0))
+        tb.update_examples_count(examples)
+        tb.log_scalar("losses/train_loss", acc.mean("loss"))
+        tb.log_scalar("losses/test_loss", val_stats["loss"])
+        tb.log_scalar("losses/top1", top1)
+        tb.log_scalar("losses/top5", top5)
+        tb.log_scalar("times/epoch_seconds", train_time)
+        if examples and train_time > 0:
+            tb.log_scalar("times/images_per_sec", examples / train_time)
+        if "comm/sent_bits" in acc.sums and train_time > 0:
+            # analytic ring-allreduce traffic at the epoch's measured rate
+            payload_b = acc.mean("comm/sent_bits") / 8  # bytes per step
+            steps_done = examples / max(int(pd.cur["bs"]), 1)
+            ring = 2 * (ndev - 1) / max(ndev, 1)
+            tb.log_scalar("net/payload_mb_per_step", payload_b / 1e6)
+            tb.log_scalar("net/allreduce_gbps_per_chip",
+                          ring * payload_b * steps_done / 1e9 / train_time)
+        recv_g, sent_g = net_meter.update_bandwidth()
+        tb.log_scalar("net/recv_gbit_s", recv_g)
+        tb.log_scalar("net/transmit_gbit_s", sent_g)
         if ckpt:
             ckpt.save_if_best(state, top5, floor=args.best_floor,
                               meta={"epoch": epoch, "top1": top1, "top5": top5})
     if args.logdir:
         tsv.save(args.logdir)
+    tb.close()
     if ckpt:
         ckpt.close()
     return summary

@@ -1,17 +1,32 @@
-"""Training loggers: console table, TSV, and metric averaging.
+"""Training loggers: console table, TSV, tensorboard, files, metric averaging.
 
-Covers the reference's CIFAR logging stack — ``TableLogger``
+Covers the reference's logging stack — CIFAR ``TableLogger``
 (`CIFAR10/core.py:31-37`), ``TSVLogger`` (`dawn.py:89-96`, the DAWNBench
-submission format), ``StatsLogger`` (`core.py:161-173`) — plus meters from the
-ImageNet side (`IMAGENET/training/meter.py:4-22`).
+submission format), ``StatsLogger`` (`core.py:161-173`), the ImageNet
+``AverageMeter`` (`IMAGENET/training/meter.py:4-22`), the master-only
+``TensorboardLogger`` with scalar JSON export and an examples-count x-axis
+(`logger.py:13-68`; the wandb mirror is not reproduced — zero-egress), and
+the three-file ``FileLogger`` (verbose/event/debug, rank-prefixed console,
+`logger.py:74-121`).
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import os
-from typing import Dict, Iterable, List
+import sys
+from typing import Dict, Iterable, List, Optional
 
-__all__ = ["TableLogger", "TSVLogger", "AverageMeter", "MetricAccumulator"]
+__all__ = [
+    "TableLogger",
+    "TSVLogger",
+    "AverageMeter",
+    "MetricAccumulator",
+    "TensorboardLogger",
+    "FileLogger",
+    "NoOp",
+]
 
 
 class TableLogger:
@@ -68,6 +83,97 @@ class AverageMeter:
         self.count += n
         self.smooth_avg = val if self.count == n else self.smooth_avg * 0.9 + val * 0.1
         self.avg = self.sum / self.count
+
+
+class NoOp:
+    """Absorbing sink for non-master ranks (`logger.py:124-127`)."""
+
+    def __getattr__(self, name):
+        def noop(*args, **kwargs):
+            return None
+
+        return noop
+
+
+class TensorboardLogger:
+    """Master-only tensorboard writer, x-axis in cumulative *examples*
+    (`logger.py:24-34`: "Tensorboard is easier to parse if global_step is
+    examples seen"); scalars mirrored to a JSON file on close
+    (`logger.py:36-38`).  Instantiate on every rank — non-master ranks get a
+    no-op (the reference gated identically)."""
+
+    def __new__(cls, output_dir: Optional[str], is_master: bool = True):
+        if not output_dir or not is_master:
+            return NoOp()
+        return super().__new__(cls)
+
+    def __init__(self, output_dir: str, is_master: bool = True):
+        from torch.utils.tensorboard import SummaryWriter
+
+        os.makedirs(output_dir, exist_ok=True)
+        self.writer = SummaryWriter(output_dir)
+        self.output_dir = output_dir
+        self.examples = 0
+        self.scalars: Dict[str, List] = {}
+
+    def update_examples_count(self, n: int) -> None:
+        self.examples += int(n)
+
+    def log_scalar(self, tag: str, value: float, step: Optional[int] = None) -> None:
+        step = self.examples if step is None else step
+        self.writer.add_scalar(tag, value, step)
+        self.scalars.setdefault(tag, []).append([step, float(value)])
+
+    def log_metrics(self, metrics: Dict[str, float], prefix: str = "") -> None:
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)):
+                self.log_scalar(prefix + k, v)
+
+    def close(self) -> None:
+        with open(os.path.join(self.output_dir, "scalars.json"), "w") as f:
+            json.dump(self.scalars, f)
+        self.writer.close()
+
+
+class FileLogger:
+    """Three-file logger + rank-prefixed console (`logger.py:74-121`):
+    ``verbose.log`` (INFO+), ``event.log`` (WARN+ — the ``~~epoch`` summary
+    lines go here via :meth:`event`), ``debug.log`` (DEBUG+ with
+    timestamps).  Only the master rank writes files; every rank prints."""
+
+    def __init__(self, output_dir: Optional[str], rank: int = 0,
+                 is_master: bool = True):
+        self.rank = rank
+        self.logger = logging.getLogger(f"tpu_compressed_dp.r{rank}")
+        self.logger.setLevel(logging.DEBUG)
+        self.logger.handlers = []
+        self.logger.propagate = False
+        console = logging.StreamHandler(sys.stdout)
+        console.setLevel(logging.DEBUG)
+        console.setFormatter(logging.Formatter(f"{rank}: %(message)s"))
+        self.logger.addHandler(console)
+        if output_dir and is_master:
+            os.makedirs(output_dir, exist_ok=True)
+            for fname, level, fmt in [
+                ("verbose.log", logging.INFO, "%(message)s"),
+                ("event.log", logging.WARNING, "%(message)s"),
+                ("debug.log", logging.DEBUG, "%(asctime)s %(levelname)s %(message)s"),
+            ]:
+                h = logging.FileHandler(os.path.join(output_dir, fname))
+                h.setLevel(level)
+                h.setFormatter(logging.Formatter(fmt))
+                self.logger.addHandler(h)
+
+    def debug(self, msg: str) -> None:
+        self.logger.debug(msg)
+
+    def info(self, msg: str) -> None:
+        self.logger.info(msg)
+
+    def event(self, msg: str) -> None:
+        """Epoch-summary channel (reference logs these at WARN so they land
+        in event.log, `train_imagenet_nv.py:232,243`)."""
+        self.logger.warning(msg)
 
 
 class MetricAccumulator:

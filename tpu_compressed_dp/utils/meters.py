@@ -1,0 +1,128 @@
+"""Timing and communication meters.
+
+Re-implements the reference's `IMAGENET/training/meter.py`:
+  * ``TimeMeter`` (`meter.py:49-60`) — data-wait vs step time.  Under JAX's
+    async dispatch the device step time is not observable per-step without
+    stalling the pipeline, so the meter tracks what the host can honestly
+    see: input-pipeline wait and dispatch time; whole-epoch device time comes
+    from the epoch barrier (`harness/loop.py`).
+  * ``NetworkMeter`` (`meter.py:24-47,66-86`) — real NIC Gbit/s from
+    /proc/net/dev deltas.  On a TPU pod this sees only DCN (host-to-host)
+    traffic; ICI bytes never cross the NIC, which is why the framework also
+    accounts payloads analytically (``CommMeter``).
+  * ``CommMeter`` — analytic bytes-on-wire accumulated from the train step's
+    ``comm/*`` metrics; the TPU-native replacement for measuring compression
+    payloads off the NIC.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+__all__ = ["TimeMeter", "NetworkMeter", "CommMeter", "network_bytes"]
+
+
+class TimeMeter:
+    """Host-side split of the train loop: data wait vs dispatch."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.data_time = 0.0
+        self.dispatch_time = 0.0
+        self.batches = 0
+        self._t = time.perf_counter()
+
+    def batch_loaded(self):
+        now = time.perf_counter()
+        self.data_time += now - self._t
+        self._t = now
+
+    def batch_dispatched(self):
+        now = time.perf_counter()
+        self.dispatch_time += now - self._t
+        self._t = now
+        self.batches += 1
+
+    def summary(self) -> Dict[str, float]:
+        n = max(self.batches, 1)
+        return {
+            "data ms/batch": self.data_time / n * 1e3,
+            "dispatch ms/batch": self.dispatch_time / n * 1e3,
+        }
+
+
+def network_bytes() -> Tuple[int, int]:
+    """Total (recv, transmit) bytes across non-loopback NICs
+    (`meter.py:66-86`)."""
+    recv = transmit = 0
+    try:
+        with open("/proc/net/dev") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return 0, 0
+    for line in lines[2:]:
+        iface, _, rest = line.partition(":")
+        if iface.strip() == "lo" or not rest:
+            continue
+        cols = rest.split()
+        recv += int(cols[0])
+        transmit += int(cols[8])
+    return recv, transmit
+
+
+class NetworkMeter:
+    """Real NIC bandwidth over the interval since the last call
+    (`meter.py:24-47`)."""
+
+    def __init__(self):
+        self.last_t = time.perf_counter()
+        self.last_recv, self.last_transmit = network_bytes()
+
+    def update_bandwidth(self) -> Tuple[float, float]:
+        """Returns (recv_gbit/s, transmit_gbit/s) since the previous call."""
+        now = time.perf_counter()
+        recv, transmit = network_bytes()
+        dt = max(now - self.last_t, 1e-9)
+        rg = (recv - self.last_recv) * 8 / 1e9 / dt
+        tg = (transmit - self.last_transmit) * 8 / 1e9 / dt
+        self.last_t, self.last_recv, self.last_transmit = now, recv, transmit
+        return rg, tg
+
+
+class CommMeter:
+    """Analytic gradient-sync traffic accumulated from ``comm/*`` metrics.
+
+    ``update`` takes one step's metrics dict; ``gbps`` converts the payload
+    accumulated since the last call into ring-allreduce GB/s per chip.
+    """
+
+    def __init__(self, world: int):
+        self.world = max(world, 1)
+        self.reset()
+
+    def reset(self):
+        self.payload_bytes = 0.0
+        self.dense_bytes = 0.0
+        self.steps = 0
+        self._t = time.perf_counter()
+
+    def update(self, metrics: Dict[str, float]) -> None:
+        if "comm/sent_bits" not in metrics:
+            return
+        self.payload_bytes += float(metrics["comm/sent_bits"]) / 8
+        self.dense_bytes += float(metrics["comm/dense_elems"]) * 4
+        self.steps += 1
+
+    def gbps(self) -> Dict[str, float]:
+        dt = max(time.perf_counter() - self._t, 1e-9)
+        ring = 2 * (self.world - 1) / self.world
+        out = {
+            "net/payload_mb_per_step": self.payload_bytes / max(self.steps, 1) / 1e6,
+            "net/allreduce_gbps_per_chip": ring * self.payload_bytes / 1e9 / dt,
+            "net/compression_frac": self.payload_bytes / max(self.dense_bytes, 1e-9),
+        }
+        self.reset()
+        return out
