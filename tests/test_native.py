@@ -1,0 +1,74 @@
+"""Native image-kernel tests: PIL parity, loader-backend equivalence."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from tpu_compressed_dp.data import imagenet as inet
+from tpu_compressed_dp.data import native
+
+
+@pytest.fixture(scope="module")
+def img():
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 255, size=(13, 17, 3)).astype(np.uint8)
+    return np.asarray(Image.fromarray(base).resize((170, 130), Image.BILINEAR),
+                      np.uint8)
+
+
+def test_builds_and_available():
+    assert native.available()  # g++ is part of the image toolchain
+
+
+@pytest.mark.parametrize("box,out,flip", [
+    ((10, 20, 150, 110), (64, 64), False),     # downscale
+    ((0, 0, 170, 130), (32, 48), True),        # heavy downscale + flip
+    ((5.5, 7.25, 100.5, 90.75), (224, 224), False),  # fractional box, upscale
+    ((0, 0, 170, 130), (130, 170), False),     # identity
+])
+def test_pil_parity(img, box, out, flip):
+    ref = np.asarray(
+        Image.fromarray(img).resize((out[1], out[0]), Image.BILINEAR, box=box),
+        np.uint8)
+    if flip:
+        ref = ref[:, ::-1]
+    got = native.crop_resize(img, box, out[0], out[1], flip)
+    assert got.shape == ref.shape and got.dtype == np.uint8
+    assert np.abs(got.astype(int) - ref.astype(int)).max() <= 1  # rounding only
+
+
+def test_identity_exact(img):
+    got = native.crop_resize(img, (0, 0, img.shape[1], img.shape[0]),
+                             img.shape[0], img.shape[1])
+    np.testing.assert_array_equal(got, img)
+
+
+def test_bad_input_raises(img):
+    with pytest.raises(ValueError, match="HWC"):
+        native.crop_resize(img[..., 0], (0, 0, 8, 8), 8, 8)
+
+
+class TestLoaderBackends:
+    def test_train_loader_backend_parity(self):
+        ds = inet.SyntheticImages(32, num_classes=8)
+        a = inet.TrainLoader(ds, 8, 32, seed=5, workers=2, backend="pil")
+        b = inet.TrainLoader(ds, 8, 32, seed=5, workers=2, backend="native")
+        for ba, bb in zip(a, b):
+            np.testing.assert_array_equal(ba["target"], bb["target"])
+            diff = np.abs(ba["input"].astype(int) - bb["input"].astype(int))
+            assert diff.max() <= 1  # same boxes/flips; rounding-only pixels
+
+    def test_val_loader_backend_close(self):
+        ds = inet.SyntheticImages(32, num_classes=8)
+        a = inet.ValLoader(ds, 8, 32, workers=2, backend="pil")
+        b = inet.ValLoader(ds, 8, 32, workers=2, backend="native")
+        for ba, bb in zip(a, b):
+            diff = np.abs(ba["input"].astype(int) - bb["input"].astype(int))
+            assert diff.max() <= 1  # native box reproduces the two-step crop
+
+    def test_native_requested_explicitly(self):
+        ds = inet.SyntheticImages(8, num_classes=2)
+        dl = inet.TrainLoader(ds, 4, 16, backend="native")
+        assert dl.native
+        batch = next(iter(dl))
+        assert batch["input"].shape == (4, 16, 16, 3)

@@ -145,10 +145,9 @@ def val_batch_size(sz: int, bs: int) -> int:
     return max(bs, floor)
 
 
-def _random_resized_crop(img, sz: int, min_scale: float, rng: np.random.Generator):
-    """torchvision ``RandomResizedCrop(sz, scale=(min_scale, 1.0))`` semantics
-    (`dataloader.py:36-39`)."""
-    w, h = img.size
+def _rrc_box(w: int, h: int, min_scale: float, rng: np.random.Generator):
+    """torchvision ``RandomResizedCrop(scale=(min_scale, 1.0))`` box sampling
+    (`dataloader.py:36-39`); returns (x0, y0, x1, y1)."""
     area = w * h
     for _ in range(10):
         target_area = area * rng.uniform(min_scale, 1.0)
@@ -159,12 +158,24 @@ def _random_resized_crop(img, sz: int, min_scale: float, rng: np.random.Generato
         if 0 < cw <= w and 0 < ch <= h:
             x0 = int(rng.integers(0, w - cw + 1))
             y0 = int(rng.integers(0, h - ch + 1))
-            box = (x0, y0, x0 + cw, y0 + ch)
-            return img.resize((sz, sz), Image.BILINEAR, box=box)
-    # fallback: center crop of the largest in-ratio square
+            return (x0, y0, x0 + cw, y0 + ch)
+    # fallback: center crop of the largest square
     side = min(w, h)
     x0, y0 = (w - side) // 2, (h - side) // 2
-    return img.resize((sz, sz), Image.BILINEAR, box=(x0, y0, x0 + side, y0 + side))
+    return (x0, y0, x0 + side, y0 + side)
+
+
+def _use_native(backend: str) -> bool:
+    if backend == "pil":
+        return False
+    from tpu_compressed_dp.data import native
+
+    if backend == "native":
+        if not native.available():
+            raise RuntimeError("backend='native' requested but the native "
+                               "image kernel failed to build")
+        return True
+    return native.available()  # auto
 
 
 def _center_crop_resize(img, out_w: int, out_h: int, enlarge: float = 1.0):
@@ -200,7 +211,8 @@ class TrainLoader:
 
     def __init__(self, dataset, batch_size: int, sz: int, *,
                  min_scale: float = 0.08, seed: int = 0, workers: int = 4,
-                 process_index: int = 0, process_count: int = 1):
+                 process_index: int = 0, process_count: int = 1,
+                 backend: str = "auto"):
         self.ds = dataset
         self.batch_size = int(batch_size)
         self.sz = int(sz)
@@ -209,6 +221,7 @@ class TrainLoader:
         self.workers = max(int(workers), 1)
         self.pi, self.pc = int(process_index), int(process_count)
         self.epoch = 0
+        self.native = _use_native(backend)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
@@ -219,11 +232,18 @@ class TrainLoader:
     def _decode(self, job: Tuple[int, int]) -> np.ndarray:
         idx, aug_seed = job
         rng = np.random.default_rng([self.seed, self.epoch, aug_seed])
-        img = _random_resized_crop(self.ds.load(idx), self.sz, self.min_scale, rng)
-        arr = np.asarray(img, np.uint8)
-        if rng.random() < 0.5:  # RandomHorizontalFlip (`dataloader.py:38`)
-            arr = arr[:, ::-1]
-        return arr
+        img = self.ds.load(idx)
+        w, h = img.size
+        box = _rrc_box(w, h, self.min_scale, rng)
+        flip = rng.random() < 0.5  # RandomHorizontalFlip (`dataloader.py:38`)
+        if self.native:
+            from tpu_compressed_dp.data import native
+
+            return native.crop_resize(np.asarray(img, np.uint8), box,
+                                      self.sz, self.sz, flip)
+        arr = np.asarray(img.resize((self.sz, self.sz), Image.BILINEAR, box=box),
+                         np.uint8)
+        return arr[:, ::-1] if flip else arr
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         rng = np.random.default_rng([self.seed, self.epoch, 0xE90C])
@@ -250,7 +270,8 @@ class ValLoader:
 
     def __init__(self, dataset, batch_size: int, sz: int, *,
                  rect_val: bool = False, ar_buckets: int = 8, workers: int = 4,
-                 process_index: int = 0, process_count: int = 1):
+                 process_index: int = 0, process_count: int = 1,
+                 backend: str = "auto"):
         self.ds = dataset
         self.batch_size = int(batch_size)
         self.sz = int(sz)
@@ -258,6 +279,7 @@ class ValLoader:
         self.ar_buckets = max(int(ar_buckets), 1)
         self.workers = max(int(workers), 1)
         self.pi, self.pc = int(process_index), int(process_count)
+        self.native = _use_native(backend)
         n = len(dataset)
         self.expected_num_batches = max(
             -(-n // (self.batch_size * self.pc)), 1
@@ -299,6 +321,20 @@ class ValLoader:
         idx, h, w = job
         img = self.ds.load(idx)
         enlarge = 1.14 if not self.rect_val else 1.0  # Resize(int(sz*1.14))
+        if self.native:
+            from tpu_compressed_dp.data import native
+
+            # reproduce the two-step resize+integer-crop as one source box:
+            # the crop rectangle in resized coords maps back through the
+            # exact (rounded) resize dimensions
+            sw, sh = img.size
+            scale = max(w * enlarge / sw, h * enlarge / sh)
+            rw = max(int(round(sw * scale)), w)
+            rh = max(int(round(sh * scale)), h)
+            cx0, cy0 = (rw - w) // 2, (rh - h) // 2
+            box = (cx0 * sw / rw, cy0 * sh / rh,
+                   (cx0 + w) * sw / rw, (cy0 + h) * sh / rh)
+            return native.crop_resize(np.asarray(img, np.uint8), box, h, w)
         return np.asarray(_center_crop_resize(img, w, h, enlarge), np.uint8)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
