@@ -62,7 +62,22 @@ MODELS = {
     ),
     "alexnet_module": lambda s=1.0: alexnet_mod.AlexNet(),
     "vgg16": lambda s=1.0: vgg_mod.vgg16(),
+    # spec-built variants via the graph runtime (`core.py:136`-equivalent)
+    "resnet9_graph": lambda s=1.0: _graph_net("resnet9", s),
+    "alexnet_graph": lambda s=1.0: _graph_net("alexnet", s),
 }
+
+
+def _graph_net(kind: str, scale: float):
+    from tpu_compressed_dp.models import graph as graph_mod
+
+    base = {"resnet9": {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512},
+            "alexnet": {"prep": 64, "layer1": 192, "layer2": 384,
+                        "layer3": 256, "layer4": 256}}[kind]
+    ch = {k: max(int(v * scale), 8) for k, v in base.items()}
+    spec = (graph_mod.resnet9_spec(channels=ch) if kind == "resnet9"
+            else graph_mod.alexnet_spec(channels=ch))
+    return graph_mod.GraphNet(spec)
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -1,0 +1,253 @@
+"""Graph-spec network builder: nested-dict DAG -> executable flax module.
+
+The reference builds its DAWNBench nets from nested dict specs interpreted at
+runtime: ``path_iter`` flattens nested dicts to '/'-joined paths,
+``build_graph`` wires each node to the previous one unless an explicit
+(`RelativePath`) edge is given (`CIFAR10/core.py:123-141`), and
+``Network.forward`` walks the DAG caching every node output in a dict
+(`torch_backend.py:107-118`) — with ``loss``/``correct`` as ordinary graph
+nodes (`dawn.py:84-87`).
+
+TPU-native re-design: the spec is still a nested dict (same ergonomics, same
+default-sequential + explicit-edge wiring), but it compiles to ONE flax
+module traced once under jit — the interpreter loop exists only at trace
+time, so XLA sees a flat fused graph, not a Python walk per step.  Loss
+stays out of the graph (the train step owns it; `train/step.py`), and the
+node vocabulary (`Identity``/``Mul``/``Flatten``/``Add``/``Concat``,
+`torch_backend.py:69-90`) is plain callables on arrays.
+
+Spec format::
+
+    spec = {
+        "prep": ConvBN(64),
+        "layer1": {"conv": ConvBN(128), "pool": MaxPool(2)},
+        "join": (Add(), ["prep", "layer1/pool"]),   # explicit inputs
+        "logits": Mul(0.125),
+    }
+    net = GraphNet(spec)        # net(x) -> last node's output
+    GraphNet(spec, outputs=("logits", "layer1/pool"))  # -> dict of outputs
+
+Node values: a flax module or any callable taking ``(x, train=...)`` or
+``(x)``; a tuple ``(node, [input paths])`` for explicit edges; or a nested
+dict.  Paths are '/'-joined; relative references may use ``../`` (resolved
+against the node's own directory, the ``RelativePath`` equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = [
+    "GraphNet", "build_graph", "path_iter",
+    "Identity", "Mul", "Flatten", "Add", "Concat", "MaxPool",
+    "resnet9_spec", "alexnet_spec",
+]
+
+
+# ---------------------------------------------------------------------------
+# node vocabulary (`torch_backend.py:69-90`)
+# ---------------------------------------------------------------------------
+
+
+class Identity:
+    def __call__(self, x):
+        return x
+
+
+@dataclasses.dataclass
+class Mul:
+    weight: float
+
+    def __call__(self, x):
+        return x * self.weight
+
+
+class Flatten:
+    def __call__(self, x):
+        return x.reshape((x.shape[0], -1))
+
+
+class Add:
+    def __call__(self, x, y):
+        return x + y
+
+
+class Concat:
+    def __call__(self, *xs):
+        return jnp.concatenate(xs, axis=-1)
+
+
+@dataclasses.dataclass
+class MaxPool:
+    window: int
+
+    def __call__(self, x):
+        return nn.max_pool(x, (self.window, self.window),
+                           strides=(self.window, self.window))
+
+
+# ---------------------------------------------------------------------------
+# spec flattening and wiring (`core.py:123-141`)
+# ---------------------------------------------------------------------------
+
+
+def path_iter(nested, pfx: Tuple[str, ...] = ()):
+    """Yield ``(path_tuple, value)`` leaves of a nested mapping
+    (`core.py:123-127`).  Accepts any Mapping — flax freezes attribute dicts
+    into FrozenDicts."""
+    from collections.abc import Mapping
+
+    for name, val in nested.items():
+        if isinstance(val, Mapping):
+            yield from path_iter(val, pfx + (str(name),))
+        else:
+            yield pfx + (str(name),), val
+
+
+def _resolve(path: str, at: Tuple[str, ...]) -> str:
+    """Resolve relative input paths against the node's directory: ``./x`` is
+    a sibling, each ``../`` climbs one level (the ``RelativePath``
+    equivalent); anything else is absolute."""
+    if not (path.startswith("./") or path.startswith("../")):
+        return path
+    parts = list(at[:-1])
+    while True:
+        if path.startswith("./"):
+            path = path[2:]
+        elif path.startswith("../"):
+            parts = parts[:-1]
+            path = path[3:]
+        else:
+            break
+    return "/".join(parts + ([path] if path else []))
+
+
+def build_graph(spec: Dict) -> Dict[str, Tuple[Any, Tuple[str, ...]]]:
+    """Flatten a nested spec to ``{path: (node, input_paths)}`` in insertion
+    order, wiring each node to its predecessor unless explicit inputs are
+    given (`core.py:129-141`).  The first node's input is the graph input
+    (denoted by the empty tuple)."""
+    graph: Dict[str, Tuple[Any, Tuple[str, ...]]] = {}
+    prev: Optional[str] = None
+    for path_t, val in path_iter(spec):
+        path = "/".join(path_t)
+        if isinstance(val, tuple):
+            node, inputs = val
+            inputs = tuple(_resolve(p, path_t) for p in inputs)
+            for p in inputs:
+                if p not in graph:
+                    raise ValueError(f"node {path!r}: unknown input {p!r} "
+                                     f"(known: {list(graph)})")
+        else:
+            node = val
+            inputs = (prev,) if prev is not None else ()
+        graph[path] = (node, inputs)
+        prev = path
+    if not graph:
+        raise ValueError("empty graph spec")
+    return graph
+
+
+class GraphNet(nn.Module):
+    """Executable DAG — the ``Network`` equivalent (`torch_backend.py:107-118`).
+
+    ``outputs=None`` returns the final node's value; a tuple of paths returns
+    ``{path: value}`` (the reference returned the full cache; request the
+    paths you need so dead branches get pruned by XLA).
+    """
+
+    spec: Any  # nested dict (static; hashed by id via flax's FrozenDict wrap)
+    outputs: Optional[Tuple[str, ...]] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        import inspect
+
+        graph = build_graph(self.spec)
+        cache: Dict[str, Any] = {}
+        for path, (node, inputs) in graph.items():
+            args = [x] if not inputs else [cache[p] for p in inputs]
+            if isinstance(node, nn.Module):
+                # re-construct inside this compact scope (a module built here
+                # auto-binds as a child) named by path, so param trees mirror
+                # the spec layout
+                fields = {
+                    f.name: getattr(node, f.name)
+                    for f in dataclasses.fields(node)
+                    if f.name not in ("parent", "name")
+                }
+                bound = type(node)(**fields, name=path.replace("/", "_"))
+                if "train" in inspect.signature(type(node).__call__).parameters:
+                    out = bound(*args, train=train)
+                else:
+                    out = bound(*args)
+            else:
+                out = node(*args)
+            cache[path] = out
+        if self.outputs is None:
+            return cache[path]
+        return {p: cache[p] for p in self.outputs}
+
+
+# ---------------------------------------------------------------------------
+# the reference's spec-built nets (`dawn.py:23-82`)
+# ---------------------------------------------------------------------------
+
+
+def resnet9_spec(num_classes: int = 10, channels: Optional[Dict[str, int]] = None,
+                 classifier_weight: float = 0.125) -> Dict:
+    """`resnet9()` as a spec (`dawn.py:44-56,70-77`): residuals are explicit
+    Add edges, exactly how the reference wired them."""
+    from tpu_compressed_dp.models.resnet9 import ConvBN
+
+    ch = channels or {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}
+
+    def res_block(c):
+        # `dawn.py:37-43`: residual branch + Add join back to the trunk
+        return {
+            "in": Identity(),
+            "res1": ConvBN(c),
+            "res2": ConvBN(c),
+            "add": (Add(), ["./in", "./res2"]),
+        }
+
+    return {
+        "prep": ConvBN(ch["prep"]),
+        "layer1": {"conv": ConvBN(ch["layer1"]), "pool": MaxPool(2),
+                   "residual": res_block(ch["layer1"])},
+        "layer2": {"conv": ConvBN(ch["layer2"]), "pool": MaxPool(2)},
+        "layer3": {"conv": ConvBN(ch["layer3"]), "pool": MaxPool(2),
+                   "residual": res_block(ch["layer3"])},
+        "pool": MaxPool(4),
+        "flatten": Flatten(),
+        "linear": nn.Dense(num_classes, use_bias=False),
+        "logits": Mul(classifier_weight),
+    }
+
+
+def alexnet_spec(num_classes: int = 10,
+                 channels: Optional[Dict[str, int]] = None,
+                 classifier_weight: float = 0.125) -> Dict:
+    """`alexnet()` as a spec (`dawn.py:57-68,79-82`)."""
+    from tpu_compressed_dp.models.resnet9 import ConvBN
+
+    ch = channels or {"prep": 64, "layer1": 192, "layer2": 384,
+                      "layer3": 256, "layer4": 256}
+    return {
+        "prep": ConvBN(ch["prep"], stride=2),
+        "pool0": MaxPool(2),
+        "layer1": ConvBN(ch["layer1"]),
+        "pool1": MaxPool(2),
+        "layer2": ConvBN(ch["layer2"]),
+        "layer3": ConvBN(ch["layer3"]),
+        "layer4": ConvBN(ch["layer4"]),
+        "pool4": MaxPool(2),
+        "pool5": MaxPool(2),
+        "flatten": Flatten(),
+        "linear": nn.Dense(num_classes, use_bias=False),
+        "logits": Mul(classifier_weight),
+    }
