@@ -39,6 +39,7 @@ __all__ = [
     "identity",
     "top_k",
     "random_k",
+    "randomk_mask",
     "threshold_v",
     "adaptive_threshold",
     "terngrad",
@@ -129,6 +130,35 @@ def top_k(g: Array, key: Optional[Array] = None, *, ratio: float) -> Array:
     return jnp.where(mag >= thresh, g, 0.0)
 
 
+def randomk_mask(key: Array, n: int, keep: int) -> Array:
+    """Boolean mask selecting a uniformly-random ``keep``-subset of ``[0, n)``.
+
+    The reference draws ``randperm(n).lt(k)`` (`core.py:186`) — a full sort.
+    TPU-native formulation: the ``keep`` *largest* of ``n`` iid uniforms are a
+    uniform random subset, and their threshold comes from the O(n)-streaming
+    histogram-select kernel (:func:`ops.kernels.topk_threshold`) instead of a
+    sort.  Uniform draws collide at fp32 resolution for large ``n``, so the
+    boundary value's ties are broken deterministically by index (one cumsum),
+    keeping the subset size exact.
+    """
+    if keep <= 0:
+        return jnp.zeros((n,), bool)
+    if keep >= n:
+        return jnp.ones((n,), bool)
+    from tpu_compressed_dp.ops import kernels
+
+    w = kernels.uniform(key, n)
+    t = kernels.topk_threshold(w, keep)
+    over = w >= t
+    # the smallest selected value may be duplicated; keep exactly `keep`
+    boundary = jnp.min(jnp.where(over, w, jnp.inf))
+    above = w > boundary
+    n_above = jnp.sum(above)
+    tie = w == boundary
+    tie_sel = tie & (jnp.cumsum(tie) <= keep - n_above)
+    return above | tie_sel
+
+
 def random_k(g: Array, key: Array, *, ratio: float) -> Array:
     """Keep a uniformly-random subset of ``~ratio*n`` coordinates (`core.py:184-188`).
 
@@ -139,8 +169,7 @@ def random_k(g: Array, key: Array, *, ratio: float) -> Array:
     """
     g = _flat(g)
     n = g.shape[0]
-    perm = jax.random.permutation(key, n)
-    mask = perm < randomk_keep_count(n, ratio)
+    mask = randomk_mask(key, n, randomk_keep_count(n, ratio))
     return jnp.where(mask, g, 0.0)
 
 

@@ -292,3 +292,45 @@ def terngrad_quantize(flat: Array, key: Array, *,
 def use_quant_kernels(n: int) -> bool:
     """Whether the fused quantizer kernels should serve this tensor."""
     return _dispatch_to_pallas(n)
+
+
+# ---------------------------------------------------------------------------
+# Hardware-PRNG uniforms
+# ---------------------------------------------------------------------------
+
+
+def _uniform_kernel(seed_ref, out_ref):
+    pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+    out_ref[:] = _uniform_from_bits(out_ref.shape)
+
+
+# PRNG seeding has per-grid-step cost — use fat blocks (512 KB) so the fill
+# is bandwidth-bound, not step-bound
+_UNIFORM_ROWS = 1024
+
+
+def _uniform_pallas(seed: Array, n: int, interpret: bool = False) -> Array:
+    chunk = _UNIFORM_ROWS * _LANES
+    padded_n = -(-n // chunk) * chunk
+    out = pl.pallas_call(
+        _uniform_kernel,
+        grid=(padded_n // chunk,),
+        in_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((_UNIFORM_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((padded_n // _LANES, _LANES), jnp.float32,
+                                       vma=_vma(seed)),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed.reshape(1, 1).astype(jnp.int32))
+    return out.reshape(-1)[:n]
+
+
+def uniform(key: Array, n: int) -> Array:
+    """Uniform [0, 1) draws; hardware PRNG on TPU at scale (threefry is
+    ~10x slower there for multi-million element draws), ``jax.random``
+    elsewhere.  Deterministic in ``key`` on both paths — a replicated key
+    yields identical draws on every worker (the shared-seed contract
+    Random-K masks rely on) — but the two paths draw different streams."""
+    if _dispatch_to_pallas(n):
+        return _uniform_pallas(_seed_from_key(key), n)
+    return jax.random.uniform(key, (n,))

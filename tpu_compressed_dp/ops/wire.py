@@ -66,13 +66,10 @@ except ImportError:  # pragma: no cover - older/newer jax layouts
 
 
 def _randomk_indices(key: Array, n: int, keep: int) -> Array:
-    """The coordinates Random-K keeps, bit-identical to the simulate mask.
-
-    Simulate keeps ``{i : perm[i] < keep}`` (`core.py:186` semantics); the
-    inverse permutation's first ``keep`` entries are exactly that set.
-    """
-    perm = jax.random.permutation(key, n)
-    return jnp.argsort(perm)[:keep]
+    """The coordinates Random-K keeps, bit-identical to the simulate mask
+    (same ``randomk_mask`` call, so wire and simulate modes always agree)."""
+    mask = compressors.randomk_mask(key, n, keep)
+    return jnp.nonzero(mask, size=keep, fill_value=0)[0]
 
 
 def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world):
