@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--heads", type=int, default=None)
     p.add_argument("--kv_heads", type=int, default=None)
     p.add_argument("--ffn", type=int, default=None)
+    p.add_argument("--experts", type=int, default=None,
+                   help="MoE expert count (0/unset = dense FFN)")
+    p.add_argument("--moe_every", type=int, default=None)
+    p.add_argument("--capacity_factor", type=float, default=None)
     p.add_argument("--fp32", action="store_true", help="disable bf16 compute")
     # mesh
     p.add_argument("--dp", type=int, default=None, help="data axis size (default: all devices)")
@@ -92,7 +96,9 @@ def build_config(args) -> tf.LlamaConfig:
     overrides = {}
     for field, arg in [("vocab_size", args.vocab), ("dim", args.dim),
                        ("n_layers", args.layers), ("n_heads", args.heads),
-                       ("n_kv_heads", args.kv_heads), ("ffn_hidden", args.ffn)]:
+                       ("n_kv_heads", args.kv_heads), ("ffn_hidden", args.ffn),
+                       ("n_experts", args.experts), ("moe_every", args.moe_every),
+                       ("capacity_factor", args.capacity_factor)]:
         if arg is not None:
             overrides[field] = arg
     if args.fp32:

@@ -52,6 +52,15 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # Mixture-of-experts (0 = dense FFN everywhere).  Experts shard over the
+    # tensor axis: activations are replicated across it in this layout, so
+    # expert-parallel dispatch needs no all_to_all — each tensor rank runs
+    # its local experts on all tokens (Switch-style top-1, fixed capacity)
+    # and one psum combines.
+    n_experts: int = 0
+    moe_every: int = 2            # MoE FFN on every k-th layer (1 = all)
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # load-balance aux loss weight
 
     @property
     def head_dim(self) -> int:
@@ -75,6 +84,15 @@ class LlamaConfig:
                 f"ffn ({self.ffn}) and vocab ({self.vocab_size}) must divide "
                 f"by tensor axis size {tensor_size}"
             )
+        if self.n_experts and self.n_experts % tensor_size:
+            raise ValueError(
+                f"n_experts ({self.n_experts}) must divide by tensor axis "
+                f"size {tensor_size}"
+            )
+
+    def is_moe_layer(self, i: int) -> bool:
+        return bool(self.n_experts) and (i % max(self.moe_every, 1) ==
+                                         max(self.moe_every, 1) - 1)
 
 
 def llama3_8b() -> LlamaConfig:
@@ -98,18 +116,30 @@ def init_llama(cfg: LlamaConfig, key: Array) -> Dict[str, Any]:
     hd = cfg.head_dim
     layers = []
     for i in range(cfg.n_layers):
-        k = jax.random.split(keys[i], 7)
-        layers.append({
+        k = jax.random.split(keys[i], 8)
+        layer = {
             "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
             "wq": dense(k[0], cfg.dim, (cfg.dim, cfg.n_heads * hd)),
             "wk": dense(k[1], cfg.dim, (cfg.dim, cfg.n_kv_heads * hd)),
             "wv": dense(k[2], cfg.dim, (cfg.dim, cfg.n_kv_heads * hd)),
             "wo": dense(k[3], cfg.n_heads * hd, (cfg.n_heads * hd, cfg.dim)),
             "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
-            "w_gate": dense(k[4], cfg.dim, (cfg.dim, cfg.ffn)),
-            "w_up": dense(k[5], cfg.dim, (cfg.dim, cfg.ffn)),
-            "w_down": dense(k[6], cfg.ffn, (cfg.ffn, cfg.dim)),
-        })
+        }
+        if cfg.is_moe_layer(i):
+            e = cfg.n_experts
+            layer.update({
+                "router": dense(k[7], cfg.dim, (cfg.dim, e)),
+                "w_gate": dense(k[4], cfg.dim, (e, cfg.dim, cfg.ffn)),
+                "w_up": dense(k[5], cfg.dim, (e, cfg.dim, cfg.ffn)),
+                "w_down": dense(k[6], cfg.ffn, (e, cfg.ffn, cfg.dim)),
+            })
+        else:
+            layer.update({
+                "w_gate": dense(k[4], cfg.dim, (cfg.dim, cfg.ffn)),
+                "w_up": dense(k[5], cfg.dim, (cfg.dim, cfg.ffn)),
+                "w_down": dense(k[6], cfg.ffn, (cfg.ffn, cfg.dim)),
+            })
+        layers.append(layer)
     return {
         "embed": jax.random.normal(keys[-3], (cfg.vocab_size, cfg.dim), jnp.float32) * 0.02,
         "layers": layers,
@@ -127,16 +157,30 @@ def param_specs(cfg: LlamaConfig, tensor_axis: str = "tensor") -> Dict[str, Any]
     those axes (their grads are what the compressed sync reduces).
     """
     t = tensor_axis
-    layer = {
-        "attn_norm": P(), "mlp_norm": P(),
-        "wq": P(None, t), "wk": P(None, t), "wv": P(None, t),
-        "wo": P(t, None),
-        "w_gate": P(None, t), "w_up": P(None, t),
-        "w_down": P(t, None),
-    }
+    layers = []
+    for i in range(cfg.n_layers):
+        layer = {
+            "attn_norm": P(), "mlp_norm": P(),
+            "wq": P(None, t), "wk": P(None, t), "wv": P(None, t),
+            "wo": P(t, None),
+        }
+        if cfg.is_moe_layer(i):
+            # expert parallelism: the leading expert dim shards over the
+            # tensor axis (router replicated — every rank routes all tokens)
+            layer.update({
+                "router": P(),
+                "w_gate": P(t, None, None), "w_up": P(t, None, None),
+                "w_down": P(t, None, None),
+            })
+        else:
+            layer.update({
+                "w_gate": P(None, t), "w_up": P(None, t),
+                "w_down": P(t, None),
+            })
+        layers.append(layer)
     return {
         "embed": P(),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": layers,
         "final_norm": P(),
         "lm_head": P(None, t),
     }
@@ -163,6 +207,56 @@ def _psum_if(x: Array, axis: Optional[str]) -> Array:
     return jax.lax.psum(x, axis) if axis is not None else x
 
 
+def _moe_ffn(cfg: LlamaConfig, lp: Dict[str, Any], x: Array,
+             tensor_axis: Optional[str]) -> Tuple[Array, Array]:
+    """Switch-style top-1 MoE FFN, experts sharded over the tensor axis.
+
+    Activations are replicated across the tensor axis in this layout, so
+    expert parallelism needs no all_to_all: every rank routes all tokens
+    (replicated router), dispatches them into its *local* experts' fixed
+    ``capacity`` slots via one-hot einsums (static shapes), and the combined
+    outputs psum across the axis.  Tokens over capacity fall through to the
+    residual stream (Switch semantics).  Capacity is per (data, seq) shard —
+    each worker's local tokens compete for ``ceil(local_tokens/E * cf)``
+    slots, so drop patterns depend on the mesh (as in any expert-parallel
+    system); results equal the unsharded layer exactly in the drop-free
+    regime (``cf >= E``).  Returns (out, load-balance aux).
+    """
+    dt = cfg.dtype
+    b, t, d = x.shape
+    n = b * t
+    e = cfg.n_experts
+    xf = x.reshape(n, d)
+    probs = jax.nn.softmax(
+        (xf @ lp["router"].astype(dt)).astype(jnp.float32), axis=-1)  # [N, E]
+    top = jnp.argmax(probs, axis=-1)
+    top_p = jnp.max(probs, axis=-1)
+    onehot = jax.nn.one_hot(top, e, dtype=jnp.float32)
+    # load-balance aux (Switch Transformer eq. 4): E * sum_e f_e * P_e
+    aux = e * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+
+    cap = max(int(math.ceil(n / e * cfg.capacity_factor)), 1)
+    pos = jnp.cumsum(onehot, axis=0) * onehot            # 1-based queue rank
+    within = (pos > 0) & (pos <= cap)
+    disp = (within[..., None] &
+            (pos[..., None] == (1.0 + jnp.arange(cap))[None, None, :])
+            ).astype(dt)                                  # [N, E, cap]
+    combine = disp * top_p[:, None, None].astype(dt)
+
+    if tensor_axis is not None:
+        e_local = lp["w_gate"].shape[0]  # static: the local shard size
+        off = jax.lax.axis_index(tensor_axis) * e_local
+        disp = jax.lax.dynamic_slice_in_dim(disp, off, e_local, axis=1)
+        combine = jax.lax.dynamic_slice_in_dim(combine, off, e_local, axis=1)
+
+    xe = jnp.einsum("nec,nd->ecd", disp, xf)             # [E_l, cap, D]
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"].astype(dt)))
+    h = h * jnp.einsum("ecd,edf->ecf", xe, lp["w_up"].astype(dt))
+    ye = jnp.einsum("ecf,efd->ecd", h, lp["w_down"].astype(dt))
+    out = _psum_if(jnp.einsum("ecd,nec->nd", ye, combine), tensor_axis)
+    return out.reshape(b, t, d), aux
+
+
 def apply_llama(
     cfg: LlamaConfig,
     params: Dict[str, Any],
@@ -170,13 +264,16 @@ def apply_llama(
     *,
     tensor_axis: Optional[str] = None,
     seq_axis: Optional[str] = None,
-) -> Array:
+    with_aux: bool = False,
+):
     """Per-device forward: ``tokens`` [B_local, T_local] -> logits
     [B_local, T_local, V_local] (vocab-sharded when ``tensor_axis`` is set).
 
     Feed the result to :func:`vocab_parallel_xent`; an explicit logit
     all-gather is deliberately not offered (a [B,T,V] global tensor is the
-    thing this layout exists to avoid).
+    thing this layout exists to avoid).  With ``with_aux`` the return is
+    ``(logits, aux)`` where aux is the mean MoE load-balance loss (0.0 for
+    dense configs).
     """
     dt = cfg.dtype
     hd = cfg.head_dim
@@ -188,8 +285,10 @@ def apply_llama(
         pos = jnp.arange(tokens.shape[1])
 
     h = params["embed"].astype(dt)[tokens]  # [B, T, D]
+    aux_total = jnp.zeros((), jnp.float32)
+    n_moe = 0
 
-    for lp in params["layers"]:
+    for li, lp in enumerate(params["layers"]):
         x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
         q = (x @ lp["wq"].astype(dt))  # [B, T, Hl*hd] (heads tensor-local)
         k = (x @ lp["wk"].astype(dt))
@@ -206,13 +305,21 @@ def apply_llama(
         h = h + attn_out
 
         x = _rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(x @ lp["w_gate"].astype(dt))
-        up = x @ lp["w_up"].astype(dt)
-        mlp_out = _psum_if((gate * up) @ lp["w_down"].astype(dt), tensor_axis)
+        if cfg.is_moe_layer(li):
+            mlp_out, aux = _moe_ffn(cfg, lp, x, tensor_axis)
+            aux_total = aux_total + aux
+            n_moe += 1
+        else:
+            gate = jax.nn.silu(x @ lp["w_gate"].astype(dt))
+            up = x @ lp["w_up"].astype(dt)
+            mlp_out = _psum_if((gate * up) @ lp["w_down"].astype(dt), tensor_axis)
         h = h + mlp_out
 
     h = _rms_norm(h, params["final_norm"], cfg.norm_eps)
-    return h @ params["lm_head"].astype(dt)  # [B, T, V_local]
+    logits = h @ params["lm_head"].astype(dt)  # [B, T, V_local]
+    if with_aux:
+        return logits, aux_total / max(n_moe, 1)
+    return logits
 
 
 def vocab_parallel_xent(

@@ -166,14 +166,15 @@ def make_lm_train_step(
         comp_key = jax.random.fold_in(state.rng, state.step)
 
         def loss_fn(params):
-            logits = apply_llama(cfg, params, x, tensor_axis="tensor",
-                                 seq_axis="seq")
-            return vocab_parallel_xent(logits, y, tensor_axis="tensor")
+            logits, aux = apply_llama(cfg, params, x, tensor_axis="tensor",
+                                      seq_axis="seq", with_aux=True)
+            xent = vocab_parallel_xent(logits, y, tensor_axis="tensor")
+            return xent + cfg.moe_aux_weight * aux, xent
 
         varying = jax.tree.map(
             lambda p: jax.lax.pcast(p, sync_axes, to="varying"), state.params
         )
-        loss, grads = jax.value_and_grad(loss_fn)(varying)
+        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying)
 
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
         g_rep, g_sh = split(grads)
