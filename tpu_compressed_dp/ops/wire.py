@@ -72,7 +72,8 @@ def _randomk_indices(key: Array, n: int, keep: int) -> Array:
     return jnp.nonzero(mask, size=keep, fill_value=0)[0]
 
 
-def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world):
+def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world,
+                       check: bool = False):
     idx = _randomk_indices(key, flat.shape[0], keep)
     payload = flat[idx]                                   # [k] — all that travels
     reduced = jax.lax.psum(payload, axis_name) / world
@@ -81,7 +82,15 @@ def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world
     # shard_map's replication inference for the psum-reduced result.
     dense = jnp.zeros(flat.shape, flat.dtype).at[idx].set(reduced)
     local_dense = jnp.zeros_like(flat).at[idx].set(payload)
-    return dense, local_dense
+    agree = None
+    if check:
+        # `check_reduction` analog: all workers must have selected the SAME
+        # indices or the packed psum silently mixes coordinates
+        h = jnp.sum(idx.astype(jnp.float64 if jax.config.jax_enable_x64
+                               else jnp.float32) * (1.0 + jnp.arange(keep) % 7))
+        agree = (jax.lax.pmax(h, axis_name) == jax.lax.pmin(h, axis_name)
+                 ).astype(jnp.float32)
+    return dense, local_dense, agree
 
 
 def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world):
@@ -156,11 +165,15 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             return compressors.randomk_keep_count(n, cfg.ratio)
         return n  # quantizers transmit every coordinate (at reduced width)
 
+    check = getattr(cfg, "check_sync", False)
+
     def sync_flat(flat: Array, ef_flat, key: Array, world):
         acc = flat + ef_flat if ef_flat is not None else flat
         keep = leaf_keep(flat.shape[0])
+        agree = None
         if comp.name == "randomk":
-            dense, local_dense = _leaf_sync_randomk(acc, key, keep, axis_name, world)
+            dense, local_dense, agree = _leaf_sync_randomk(
+                acc, key, keep, axis_name, world, check)
         elif comp.name == "topk":
             dense, local_dense = _leaf_sync_topk(acc, keep, axis_name, world)
         elif comp.name == "terngrad":
@@ -168,7 +181,7 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         else:  # qsgd
             dense, local_dense = _leaf_sync_qsgd(acc, key, cfg.qstates, axis_name, world), acc
         new_ef = acc - local_dense if ef_flat is not None else None
-        return dense, new_ef, keep
+        return dense, new_ef, keep, agree
 
     def sync(grads: Any, ef: Any, key: Array) -> Tuple[Any, Any, Dict[str, Array]]:
         world = jax.lax.psum(1, axis_name)
@@ -178,28 +191,32 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             flat, unravel = ravel_pytree(grads)
             ef_flat = ravel_pytree(ef)[0] if use_ef else None
             k0 = compressors.leaf_key(key, 0, per_worker_rng, axis_name)
-            dense, new_ef_flat, keep = sync_flat(flat, ef_flat, k0, world)
+            dense, new_ef_flat, keep, agree = sync_flat(flat, ef_flat, k0, world)
             stats = {
                 "sent_elems": jnp.asarray(float(keep), jnp.float32),
                 "sent_bits": jnp.asarray(keep * bits_per_elem, jnp.float32),
                 "dense_elems": jnp.asarray(float(flat.shape[0]), jnp.float32),
                 "num_collectives": jnp.asarray(1.0, jnp.float32),
             }
+            if agree is not None:
+                stats["sync_agree"] = agree
             return unravel(dense), (unravel(new_ef_flat) if use_ef else ()), stats
 
         leaves, treedef = jax.tree.flatten(grads)
         ef_leaves = jax.tree.leaves(ef) if use_ef else [None] * len(leaves)
-        out_leaves, new_ef_leaves = [], []
+        out_leaves, new_ef_leaves, agrees = [], [], []
         sent = 0.0
         dense_total = 0.0
         for i, (g, e) in enumerate(zip(leaves, ef_leaves)):
             flat = g.reshape(-1)
             ef_flat = e.reshape(-1) if use_ef else None
             ki = compressors.leaf_key(key, i, per_worker_rng, axis_name)
-            dense, new_ef_flat, keep = sync_flat(flat, ef_flat, ki, world)
+            dense, new_ef_flat, keep, agree = sync_flat(flat, ef_flat, ki, world)
             out_leaves.append(dense.reshape(g.shape))
             if use_ef:
                 new_ef_leaves.append(new_ef_flat.reshape(g.shape))
+            if agree is not None:
+                agrees.append(agree)
             sent += float(keep)
             dense_total += float(flat.shape[0])
 
@@ -209,6 +226,8 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
             "num_collectives": jnp.asarray(float(len(leaves)), jnp.float32),
         }
+        if agrees:
+            stats["sync_agree"] = jnp.min(jnp.stack(agrees))
         out = jax.tree.unflatten(treedef, out_leaves)
         new_ef = jax.tree.unflatten(treedef, new_ef_leaves) if use_ef else ()
         return out, new_ef, stats

@@ -60,6 +60,11 @@ class CompressionConfig:
                    `sparsified_ddp.py:164`).  Defaults: False for 'simulate'
                    (the unseeded CIFAR harness draws per-rank masks), True is
                    required for 'wire' randomk so indices line up.
+    check_sync:    debug guard (the ``check_reduction`` analog,
+                   `ddp.py:312-327`): wire-mode Random-K verifies every
+                   worker selected identical indices before the packed psum
+                   (misalignment would silently corrupt gradients) and
+                   reports ``comm/sync_agree`` (1.0 = agreement).
     """
 
     method: Optional[str] = None
@@ -70,6 +75,7 @@ class CompressionConfig:
     qstates: int = 255
     error_feedback: bool = False
     shared_mask: Optional[bool] = None
+    check_sync: bool = False
 
     def __post_init__(self):
         if self.granularity not in ("layerwise", "entiremodel"):
