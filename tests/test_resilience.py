@@ -1,0 +1,84 @@
+"""Failure detection + crash recovery tests."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.utils import resilience
+
+
+class TestHeartbeat:
+    def test_write_read_stale(self, tmp_path):
+        p = str(tmp_path / "hb.json")
+        hb = resilience.Heartbeat(p, interval_s=0.05, payload={"rank": 0})
+        hb.update(step=42, loss=1.5)
+        time.sleep(0.15)
+        hb.stop()
+        rec = resilience.read_heartbeat(p)
+        assert rec["step"] == 42 and rec["rank"] == 0 and rec["loss"] == 1.5
+        assert not resilience.is_stale(p, max_age_s=10.0)
+        assert resilience.is_stale(p, max_age_s=0.0)
+        assert resilience.is_stale(str(tmp_path / "missing.json"), 1.0)
+
+
+class TestRecovery:
+    def _tiny_state(self):
+        from tpu_compressed_dp.train.optim import SGD
+        from tpu_compressed_dp.train.state import TrainState
+
+        params = {"w": jnp.zeros((4,))}
+        opt = SGD(lr=0.1)
+        return TrainState.create(params, {}, opt.init(params), (),
+                                 jax.random.key(0))
+
+    def test_recovers_from_transient_failure(self, tmp_path):
+        from tpu_compressed_dp.utils.checkpoint import Checkpointer
+        import dataclasses
+
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        state = self._tiny_state()
+        crashes = {"left": 2}
+
+        def epoch_fn(state, epoch):
+            if epoch == 3 and crashes["left"] > 0:
+                crashes["left"] -= 1
+                raise RuntimeError("injected device loss")
+            state = dataclasses.replace(
+                state,
+                step=state.step + 1,
+                params={"w": state.params["w"] + 1.0},
+            )
+            ckpt.save(state, {"epoch": epoch})
+            return state
+
+        final, info = resilience.run_with_recovery(
+            epoch_fn, state, epochs=6, checkpointer=ckpt, max_retries=3)
+        ckpt.close()
+        assert info["restores"] == 2
+        assert int(final.step) == 6
+        np.testing.assert_allclose(np.asarray(final.params["w"]), 6.0)
+
+    def test_retry_budget_exhausted(self, tmp_path):
+        from tpu_compressed_dp.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        state = self._tiny_state()
+        ckpt.save(state, {"epoch": -1})
+
+        def always_fails(state, epoch):
+            raise RuntimeError("permanent")
+
+        with pytest.raises(RuntimeError, match="permanent"):
+            resilience.run_with_recovery(
+                always_fails, state, epochs=2, checkpointer=ckpt, max_retries=2)
+        ckpt.close()
+
+    def test_no_checkpointer_reraises(self):
+        def fails(state, epoch):
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            resilience.run_with_recovery(fails, self._tiny_state(), epochs=1)

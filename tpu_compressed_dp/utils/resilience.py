@@ -1,0 +1,120 @@
+"""Failure detection and crash recovery.
+
+The reference has neither (SURVEY.md §5): membership is fixed at launch,
+crashes print a traceback (`train_imagenet_nv.py:704-716`), and spot-instance
+recovery is "relaunch by hand" (`train.py:49`).  Net-new here:
+
+  * ``Heartbeat`` — a background thread that writes ``{ts, step, payload}``
+    to a JSON file at an interval; an external watchdog (or another host)
+    reads it with :func:`read_heartbeat` / :func:`is_stale` to detect hung or
+    dead workers.  Pure files, no control plane to operate.
+  * ``run_with_recovery`` — wraps an epoch-style loop: on an exception it
+    restores the latest checkpoint and replays from there, up to
+    ``max_retries`` consecutive failures (progress between checkpoints
+    resets the budget).  With Orbax checkpoints carrying the full
+    ``TrainState`` (EF residual and RNG included), a replayed epoch is
+    bitwise the run that would have happened without the crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["Heartbeat", "read_heartbeat", "is_stale", "run_with_recovery"]
+
+
+class Heartbeat:
+    """Background liveness file writer.
+
+    >>> hb = Heartbeat(path, interval_s=10)
+    >>> hb.update(step=123)   # cheap; call from the train loop
+    >>> hb.stop()
+    """
+
+    def __init__(self, path: str, interval_s: float = 10.0,
+                 payload: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.interval_s = interval_s
+        self.payload = dict(payload or {})
+        self._step = 0
+        self._stop = threading.Event()
+        self._write()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def update(self, step: int, **payload) -> None:
+        self._step = int(step)
+        self.payload.update(payload)
+
+    def _write(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "step": self._step, **self.payload}, f)
+        os.replace(tmp, self.path)  # atomic: readers never see partial JSON
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._write()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self.interval_s + 1)
+        self._write()
+
+
+def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def is_stale(path: str, max_age_s: float) -> bool:
+    """True when the heartbeat is missing or older than ``max_age_s``."""
+    hb = read_heartbeat(path)
+    return hb is None or (time.time() - hb["ts"]) > max_age_s
+
+
+def run_with_recovery(
+    epoch_fn: Callable[[Any, int], Any],
+    state: Any,
+    epochs: int,
+    *,
+    checkpointer=None,
+    start_epoch: int = 0,
+    max_retries: int = 3,
+    on_restore: Optional[Callable[[Any], Any]] = None,
+) -> Tuple[Any, Dict[str, int]]:
+    """Run ``state = epoch_fn(state, epoch)`` for each epoch, restoring from
+    ``checkpointer`` (latest step) and retrying after exceptions.
+
+    ``on_restore`` re-places a restored state onto the mesh (e.g.
+    ``TrainState.with_mesh_sharding`` / ``place_lm_state``).  Epoch indices
+    re-run after a restore are derived from the checkpoint meta's ``epoch``
+    (saved by the harnesses), falling back to restarting the failed epoch.
+    Returns ``(state, {'failures': n, 'restores': m})``.
+    """
+    failures = restores = 0
+    epoch = start_epoch
+    while epoch < epochs:
+        try:
+            state = epoch_fn(state, epoch)
+            failures = 0  # progress resets the retry budget
+            epoch += 1
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            failures += 1
+            if checkpointer is None or failures > max_retries:
+                raise
+            state, meta = checkpointer.restore(state)
+            if on_restore is not None:
+                state = on_restore(state)
+            restores += 1
+            epoch = int(meta.get("epoch", epoch - 1)) + 1
+    return state, {"failures": failures, "restores": restores}
