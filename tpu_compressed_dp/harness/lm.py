@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dp", type=int, default=None, help="data axis size (default: all devices)")
     p.add_argument("--sp", type=int, default=1, help="sequence axis size")
     p.add_argument("--tp", type=int, default=1, help="tensor axis size")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (uses the (data, pipe) step; "
+                        "requires --sp 1 --tp 1)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches per step (--pp > 1 only)")
     # data/schedule
     p.add_argument("--corpus", type=str, default=None, help="byte-level text file; default synthetic")
     p.add_argument("--seq_len", type=int, default=512)
@@ -111,13 +116,22 @@ def run(args) -> Dict[str, float]:
         raise ValueError(f"--method {args.method} requires --compress layerwise|entiremodel")
     distributed_init(args.coordinator, args.num_processes, args.process_id)
     ndev = len(jax.devices())
-    dp = args.dp if args.dp is not None else ndev // (args.sp * args.tp)
-    mesh = make_lm_mesh(dp, args.sp, args.tp)
+    pipelined = args.pp > 1
+    if pipelined and (args.sp != 1 or args.tp != 1):
+        raise ValueError("--pp composes with --dp only (set --sp 1 --tp 1)")
+    dp = args.dp if args.dp is not None else ndev // (args.sp * args.tp * args.pp)
+    if pipelined:
+        from tpu_compressed_dp.train.pp_step import make_pp_mesh
+
+        mesh = make_pp_mesh(dp, args.pp)
+    else:
+        mesh = make_lm_mesh(dp, args.sp, args.tp)
     cfg = build_config(args)
     cfg.validate_mesh(args.tp)
 
-    if args.global_batch % dp:
-        raise ValueError(f"--global_batch {args.global_batch} must divide by dp={dp}")
+    if args.global_batch % (dp * (args.microbatches if pipelined else 1)):
+        raise ValueError(f"--global_batch {args.global_batch} must divide by "
+                         f"dp*microbatches")
     if args.seq_len % args.sp:
         raise ValueError(f"--seq_len {args.seq_len} must divide by sp={args.sp}")
 
@@ -145,22 +159,44 @@ def run(args) -> Dict[str, float]:
         mode=args.mode, ratio=args.ratio, threshold=args.threshold,
         qstates=args.qstates, error_feedback=args.error_feedback,
     )
-    state = TrainState.create(
-        params, {}, opt.init(params), init_lm_ef_state(cfg, params, comp, mesh),
-        jax.random.key(args.seed + 1),
-    )
-    ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
-    if args.resume:
-        from tpu_compressed_dp.train.lm_step import place_lm_state
+    if pipelined:
+        from tpu_compressed_dp.train.pp_step import (
+            init_pp_ef_state, make_pp_train_step, stack_layer_params,
+        )
 
-        restore = Checkpointer(args.resume)
-        state, meta = restore.restore(state)
-        restore.close()
-        state = place_lm_state(state, cfg, comp, mesh)
-        print(f"resumed step {int(state.step)}")
+        params = stack_layer_params(params)
+        state = TrainState.create(
+            params, {}, opt.init(params),
+            init_pp_ef_state(cfg, params, comp, mesh),
+            jax.random.key(args.seed + 1),
+        )
+        train_step = make_pp_train_step(cfg, opt, comp, mesh,
+                                        microbatches=args.microbatches)
+        if args.resume or args.checkpoint_dir:
+            raise NotImplementedError(
+                "checkpointing the pipelined step: restore re-placement for "
+                "the (data, pipe) mesh is not wired yet"
+            )
+        ckpt = None
+    else:
+        state = TrainState.create(
+            params, {}, opt.init(params), init_lm_ef_state(cfg, params, comp, mesh),
+            jax.random.key(args.seed + 1),
+        )
+        ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+        if args.resume:
+            from tpu_compressed_dp.train.lm_step import place_lm_state
 
-    train_step = make_lm_train_step(cfg, opt, comp, mesh)
-    print(f"params={n_params/1e6:.1f}M mesh=dp{dp}xsp{args.sp}xtp{args.tp} "
+            restore = Checkpointer(args.resume)
+            state, meta = restore.restore(state)
+            restore.close()
+            state = place_lm_state(state, cfg, comp, mesh)
+            print(f"resumed step {int(state.step)}")
+
+        train_step = make_lm_train_step(cfg, opt, comp, mesh)
+    mesh_str = (f"dp{dp}xpp{args.pp}(mb{args.microbatches})" if pipelined
+                else f"dp{dp}xsp{args.sp}xtp{args.tp}")
+    print(f"params={n_params/1e6:.1f}M mesh={mesh_str} "
           f"seq={args.seq_len} batch={args.global_batch} "
           f"method={comp.method or 'dense'}/{comp.granularity}/{comp.mode}")
 

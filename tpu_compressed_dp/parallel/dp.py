@@ -37,7 +37,8 @@ from jax.flatten_util import ravel_pytree
 
 from tpu_compressed_dp.ops import compressors
 
-__all__ = ["CompressionConfig", "make_grad_sync", "init_ef_state"]
+__all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
+           "init_ef_state"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -202,5 +203,53 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             "num_collectives": jnp.asarray(float(len(leaves)), jnp.float32),
         }
         return out, new_ef, stats
+
+    return sync
+
+
+def make_grouped_grad_sync(cfg: CompressionConfig, sync_axes, is_sharded,
+                           shard_axis: str):
+    """Compressed sync for gradient trees that mix model-axis-SHARDED leaves
+    with model-axis-REPLICATED ones (tensor or pipeline parallelism).
+
+    Compression masks are data-dependent, so flattening both kinds together
+    would give each ``shard_axis`` rank a different mask over the replicated
+    sections and silently de-synchronise replicated parameters.  The tree is
+    split into the two groups (``is_sharded`` aligned with
+    ``jax.tree.leaves`` order): the replicated group's inputs — already
+    psum'd over ``shard_axis`` by shard_map AD — are identical on every
+    rank, so its masks agree; the sharded group syncs each shard
+    independently over ``sync_axes``.  Comm stats report model-wide totals
+    (the sharded group's per-rank stats psum over ``shard_axis``).
+    """
+    base_sync = make_grad_sync(cfg, axis_name=sync_axes)
+    is_sharded = list(is_sharded)
+
+    def split(tree):
+        leaves = jax.tree.leaves(tree)
+        return (
+            [l for l, s in zip(leaves, is_sharded) if not s],
+            [l for l, s in zip(leaves, is_sharded) if s],
+        )
+
+    def merge(like, rep, sh):
+        rep_it, sh_it = iter(rep), iter(sh)
+        leaves = [next(sh_it) if s else next(rep_it) for s in is_sharded]
+        return jax.tree.unflatten(jax.tree.structure(like), leaves)
+
+    def sync(grads, ef, key):
+        use_ef = cfg.error_feedback
+        g_rep, g_sh = split(grads)
+        e_rep, e_sh = split(ef) if use_ef else ((), ())
+        key_rep, key_sh = jax.random.split(key)
+        sync_rep, ef_rep, comm_rep = base_sync(g_rep, e_rep if use_ef else (), key_rep)
+        sync_sh, ef_sh, comm_sh = base_sync(g_sh, e_sh if use_ef else (), key_sh)
+        synced = merge(grads, sync_rep, sync_sh)
+        new_ef = merge(ef, ef_rep, ef_sh) if use_ef else ()
+        comm = {
+            k: comm_rep[k] + jax.lax.psum(comm_sh[k], shard_axis)
+            for k in comm_rep
+        }
+        return synced, new_ef, comm
 
     return sync

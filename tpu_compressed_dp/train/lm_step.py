@@ -40,7 +40,7 @@ from tpu_compressed_dp.models.transformer import (
     param_specs,
     vocab_parallel_xent,
 )
-from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grad_sync
+from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grouped_grad_sync
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import optimizer_lr
@@ -134,33 +134,16 @@ def make_lm_train_step(
     """
     cfg.validate_mesh(mesh.shape["tensor"])
     sync_axes = ("data", "seq")
-    grad_sync = make_grad_sync(comp_cfg, axis_name=sync_axes)
     n_workers = mesh.shape["data"] * mesh.shape["seq"]
 
-    # Compression masks are data-dependent (top-k threshold) — flattening
-    # tensor-SHARDED leaves together with tensor-REPLICATED ones would give
-    # each tensor shard a different mask over the replicated sections and
-    # silently de-synchronise replicated params across the tensor axis.
-    # Split the tree: the replicated group's inputs (and hence masks) are
-    # identical on every tensor shard (their grads are already tensor-psummed
-    # by shard_map AD), so its sync stays consistent; the sharded group syncs
-    # each shard independently over (data, seq).
+    # Tensor-sharded and tensor-replicated leaves sync as separate groups so
+    # data-dependent compression masks cannot de-synchronise replicated
+    # params across tensor shards (see make_grouped_grad_sync).
     pspec_leaves = jax.tree.leaves(
         param_specs(cfg), is_leaf=lambda x: isinstance(x, P)
     )
     is_sharded = [any(ax == "tensor" for ax in spec) for spec in pspec_leaves]
-
-    def split(tree):
-        leaves = jax.tree.leaves(tree)
-        return (
-            [l for l, s in zip(leaves, is_sharded) if not s],
-            [l for l, s in zip(leaves, is_sharded) if s],
-        )
-
-    def merge(treedef_like, rep, sh):
-        rep_it, sh_it = iter(rep), iter(sh)
-        leaves = [next(sh_it) if s else next(rep_it) for s in is_sharded]
-        return jax.tree.unflatten(jax.tree.structure(treedef_like), leaves)
+    grad_sync = make_grouped_grad_sync(comp_cfg, sync_axes, is_sharded, "tensor")
 
     def local_step(state: TrainState, x: Array, y: Array):
         comp_key = jax.random.fold_in(state.rng, state.step)
@@ -177,20 +160,7 @@ def make_lm_train_step(
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying)
 
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
-        g_rep, g_sh = split(grads)
-        use_ef = comp_cfg.error_feedback
-        e_rep, e_sh = split(ef_local) if use_ef else ((), ())
-        key_rep, key_sh = jax.random.split(comp_key)
-        sync_rep, ef_rep, comm_rep = grad_sync(g_rep, e_rep if use_ef else (), key_rep)
-        sync_sh, ef_sh, comm_sh = grad_sync(g_sh, e_sh if use_ef else (), key_sh)
-        synced = merge(grads, sync_rep, sync_sh)
-        new_ef = merge(ef_local, ef_rep, ef_sh) if use_ef else ()
-        # model-wide totals: the sharded group's stats differ per tensor shard
-        # (each shard is its own payload) — sum them over the tensor axis
-        comm = {
-            k: comm_rep[k] + jax.lax.psum(comm_sh[k], "tensor")
-            for k in comm_rep
-        }
+        synced, new_ef, comm = grad_sync(grads, ef_local, comp_key)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
 
         new_step = state.step + 1

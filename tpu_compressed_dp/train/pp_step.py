@@ -1,0 +1,264 @@
+"""Pipeline-parallel LM train step over a (data, pipe) mesh.
+
+Completes the parallelism families (DP/TP/SP/EP elsewhere): GPipe-style
+microbatch pipelining of the transformer stack, TPU-native formulation —
+
+  * layer parameters are stacked on a leading layer axis and sharded over
+    the ``pipe`` mesh axis, so stage ``s`` physically holds layers
+    ``[s*L/S, (s+1)*L/S)`` (embedding / LM head / final norm are replicated;
+    only the boundary stages read them);
+  * the schedule is a single differentiable loop of ``M + S - 1`` ticks: at
+    tick ``t`` stage ``s`` runs its layers on microbatch ``t - s`` and hands
+    the activation to its right neighbor with one ``ppermute`` — reverse-mode
+    AD transposes the loop into the backward pipeline automatically (the
+    transpose of ppermute is the reverse ppermute), so there is no
+    hand-written backward schedule;
+  * ramp/drain ticks compute on zero activations and are masked out of the
+    loss (compute is wasted in the bubble, as in GPipe; fraction
+    ``(S-1)/(M+S-1)``);
+  * gradient sync (with any compression config) runs over the ``data`` axis
+    exactly as in the other steps: stage-local layer gradients sync across
+    their data replicas; pipe-replicated leaves (embed/head/norm) are
+    psum'd over ``pipe`` by shard_map AD before the compressed data-axis
+    sync sees them.
+
+Composability note: this step owns the (data, pipe) composition; sequence
+and tensor axes live in :mod:`tpu_compressed_dp.train.lm_step`.  Combining
+all five axes in one step is future work — the reference had exactly one
+axis (SURVEY.md §2.2), so every composition here is net-new capability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tpu_compressed_dp.models.transformer import (
+    LlamaConfig,
+    _moe_ffn,
+    _psum_if,
+    _rms_norm,
+    _rope,
+    vocab_parallel_xent,
+)
+from tpu_compressed_dp.ops.ring_attention import ring_attention
+from tpu_compressed_dp.parallel.dp import (
+    CompressionConfig,
+    make_grouped_grad_sync,
+)
+from tpu_compressed_dp.train.optim import SGD
+from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.train.step import optimizer_lr
+
+Array = jax.Array
+
+__all__ = ["make_pp_mesh", "stack_layer_params", "pp_state_specs",
+           "make_pp_train_step", "init_pp_ef_state"]
+
+
+def make_pp_mesh(data: int, pipe: int) -> Mesh:
+    from tpu_compressed_dp.parallel.mesh import make_mesh
+
+    return make_mesh((data, pipe), ("data", "pipe"))
+
+
+def stack_layer_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """``layers: [ {k: arr} x L ] -> {k: arr[L, ...]}`` so the layer dim can
+    shard over the pipe axis.  Requires a homogeneous stack (dense FFN or
+    MoE-every-layer)."""
+    layers = params["layers"]
+    keys = set(layers[0])
+    if any(set(l) != keys for l in layers):
+        raise ValueError(
+            "pipeline stages need homogeneous layers (use moe_every=1 or "
+            "a dense FFN config)"
+        )
+    stacked = {k: jnp.stack([l[k] for l in layers]) for k in sorted(keys)}
+    return {**{k: v for k, v in params.items() if k != "layers"},
+            "layers": stacked}
+
+
+def init_pp_ef_state(cfg: LlamaConfig, stacked_params: Dict[str, Any],
+                     comp: CompressionConfig, mesh: Mesh) -> Any:
+    if not comp.error_feedback:
+        return ()
+    workers = mesh.shape["data"]
+    return jax.tree.map(
+        lambda p: jnp.zeros((workers,) + p.shape, jnp.float32), stacked_params
+    )
+
+
+def pp_state_specs(cfg: LlamaConfig, comp: CompressionConfig) -> TrainState:
+    layer_specs = {k: P("pipe") for k in (
+        ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+         "w_gate", "w_up", "w_down"] + (["router"] if cfg.n_experts else [])
+    )}
+    pspecs = {"embed": P(), "final_norm": P(), "lm_head": P(),
+              "layers": layer_specs}
+    ef_specs = jax.tree.map(lambda s: P("data", *s), pspecs,
+                            is_leaf=lambda x: isinstance(x, P))
+    return TrainState(
+        step=P(), params=pspecs, batch_stats=P(),
+        opt_state={"momentum": pspecs},
+        ef=ef_specs if comp.error_feedback else P(),
+        rng=P(),
+    )
+
+
+def _decoder_layer(cfg: LlamaConfig, lp: Dict[str, Array], h: Array,
+                   pos: Array) -> Array:
+    """One pre-norm decoder layer from unstacked per-layer params (the
+    single-device body of apply_llama, factored for reuse by the stages)."""
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+    b, t = x.shape[:2]
+    q = (x @ lp["wq"].astype(dt)).reshape(b, t, -1, hd).transpose(0, 2, 1, 3)
+    k = (x @ lp["wk"].astype(dt)).reshape(b, t, -1, hd).transpose(0, 2, 1, 3)
+    v = (x @ lp["wv"].astype(dt)).reshape(b, t, -1, hd).transpose(0, 2, 1, 3)
+    q, k = _rope(q, pos, cfg.rope_theta), _rope(k, pos, cfg.rope_theta)
+    o = ring_attention(q, k, v, axis_name=None)
+    h = h + (o.transpose(0, 2, 1, 3).reshape(b, t, -1) @ lp["wo"].astype(dt))
+    x = _rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts:
+        mlp, _ = _moe_ffn(cfg, lp, x, None)
+    else:
+        mlp = (jax.nn.silu(x @ lp["w_gate"].astype(dt))
+               * (x @ lp["w_up"].astype(dt))) @ lp["w_down"].astype(dt)
+    return h + mlp
+
+
+def make_pp_train_step(
+    cfg: LlamaConfig,
+    optimizer: SGD,
+    comp_cfg: CompressionConfig,
+    mesh: Mesh,
+    *,
+    microbatches: int,
+    donate: bool = True,
+):
+    """Build ``train_step(state, batch) -> (state, metrics)``.
+
+    ``state.params`` must be in stacked form (:func:`stack_layer_params`).
+    ``batch['input'|'target']``: [B, T] with ``B`` divisible by
+    ``data_size * microbatches``.
+    """
+    stages = mesh.shape["pipe"]
+    if cfg.n_layers % stages:
+        raise ValueError(f"n_layers ({cfg.n_layers}) must divide by pipe "
+                         f"size {stages}")
+    if cfg.n_experts and cfg.moe_every != 1:
+        raise ValueError("pipeline stages need homogeneous layers: MoE "
+                         "configs require moe_every=1")
+    layers_per_stage = cfg.n_layers // stages
+    M = microbatches
+    # pipe-sharded layer stacks vs pipe-replicated embed/head/norm sync as
+    # separate groups (see make_grouped_grad_sync)
+    spec_tree = pp_state_specs(cfg, comp_cfg).params
+    spec_leaves = jax.tree.leaves(spec_tree, is_leaf=lambda x: isinstance(x, P))
+    is_sharded = [any(ax == "pipe" for ax in spec) for spec in spec_leaves]
+    grad_sync = make_grouped_grad_sync(comp_cfg, ("data",), is_sharded, "pipe")
+    n_workers = mesh.shape["data"]
+    dt = cfg.dtype
+
+    def local_step(state: TrainState, x: Array, y: Array):
+        comp_key = jax.random.fold_in(state.rng, state.step)
+        stage = jax.lax.axis_index("pipe")
+        b_local, t_len = x.shape
+        mb = b_local // M
+        xs = x.reshape(M, mb, t_len)
+        ys = y.reshape(M, mb, t_len)
+        pos = jnp.arange(t_len)
+        perm = [(i, (i + 1) % stages) for i in range(stages)]
+
+        def loss_fn(params):
+            def stage_apply(h):
+                for i in range(layers_per_stage):
+                    lp = jax.tree.map(lambda a: a[i], params["layers"])
+                    h = _decoder_layer(cfg, lp, h, pos)
+                return h
+
+            def tick(t, carry):
+                h_cur, loss_sum, tok_sum = carry
+                # stage 0 injects microbatch t (clamped; masked by `inject`)
+                inject = (stage == 0) & (t < M)
+                x_t = xs[jnp.clip(t, 0, M - 1)]
+                emb = params["embed"].astype(dt)[x_t]
+                emb = jax.lax.pcast(emb, ("pipe",), to="varying")
+                h_in = jnp.where(inject, emb, h_cur)
+                h_out = stage_apply(h_in)
+                # last stage emits microbatch t - (S-1)
+                out_idx = t - (stages - 1)
+                emit = (stage == stages - 1) & (out_idx >= 0) & (out_idx < M)
+                y_t = ys[jnp.clip(out_idx, 0, M - 1)]
+                hn = _rms_norm(h_out, params["final_norm"], cfg.norm_eps)
+                logits = hn @ params["lm_head"].astype(dt)
+                nll = vocab_parallel_xent(logits, y_t)
+                loss_sum = loss_sum + jnp.where(emit, nll, 0.0)
+                tok_sum = tok_sum + jnp.where(emit, 1.0, 0.0)
+                h_next = jax.lax.ppermute(h_out, "pipe", perm)
+                return h_next, loss_sum, tok_sum
+
+            h0 = jax.lax.pcast(jnp.zeros((mb, t_len, cfg.dim), dt),
+                               ("data", "pipe"), to="varying")
+            zero = jax.lax.pcast(jnp.zeros((), jnp.float32),
+                                 ("data", "pipe"), to="varying")
+            _, loss_sum, tok_sum = jax.lax.fori_loop(
+                0, M + stages - 1, tick, (h0, zero, zero))
+            # mean over microbatches; share from the last stage to all
+            loss = jax.lax.psum(loss_sum, "pipe") / jnp.maximum(
+                jax.lax.psum(tok_sum, "pipe"), 1.0)
+            return loss
+
+        varying = jax.tree.map(
+            lambda p: jax.lax.pcast(p, ("data",), to="varying"), state.params
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(varying)
+
+        ef_local = jax.tree.map(lambda e: e[0], state.ef)
+        synced, new_ef, comm = grad_sync(grads, ef_local, comp_key)
+        new_ef = jax.tree.map(lambda e: e[None], new_ef)
+
+        new_step = state.step + 1
+        new_params, new_opt = optimizer.apply(state.params, synced,
+                                              state.opt_state, new_step)
+        metrics = {
+            "loss": jax.lax.pmean(loss, "data"),
+            "tokens": jax.lax.psum(
+                jnp.asarray(b_local * t_len, jnp.float32), "data"),
+            "lr": optimizer_lr(optimizer, new_step),
+        }
+        for k, v in comm.items():
+            metrics[f"comm/{k}"] = jax.lax.pmean(v, "data")
+        return dataclasses.replace(
+            state, step=new_step, params=new_params, opt_state=new_opt,
+            ef=new_ef,
+        ), metrics
+
+    state_spec = pp_state_specs(cfg, comp_cfg)
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec, P("data"), P("data")),
+        out_specs=(state_spec, P()),
+    )
+    jitted = partial(jax.jit, donate_argnums=(0,) if donate else ())(
+        lambda state, x, y: sharded(state, x, y)
+    )
+
+    def train_step(state: TrainState, batch: Dict[str, Array]):
+        for leaf in jax.tree.leaves(state.ef):
+            if leaf.ndim < 1 or leaf.shape[0] != n_workers:
+                raise ValueError(
+                    f"PP EF residual needs leading axis {n_workers}; got "
+                    f"{leaf.shape} — build with init_pp_ef_state"
+                )
+        return jitted(state, batch["input"], batch["target"])
+
+    return train_step
