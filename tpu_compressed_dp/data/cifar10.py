@@ -96,7 +96,8 @@ def augment_epoch(
 ) -> np.ndarray:
     """One epoch's worth of Crop + FlipLR + Cutout, choices pre-sampled per
     sample exactly like ``Transform.set_random_choices`` (`core.py:107-114`),
-    applied vectorised.  ``x`` is padded NHWC float32."""
+    applied vectorised.  ``x`` is padded NHWC, uint8 or float32 (uint8 stays
+    uint8 — normalisation belongs on device)."""
     n, h, w, c = x.shape
     ch, cw = crop
     y0 = rng.randint(0, h - ch + 1, n)

@@ -195,19 +195,13 @@ class PhaseData:
         return out
 
 
-def make_normalizing_apply_fn(module):
-    """Wrap the model so uint8 NHWC batches are normalised on device —
-    ``BatchTransformDataLoader.process_tensors`` (`dataloader.py:92-99`) moved
-    inside the compiled step (and off the host->TPU wire: uint8 in, bf16 maths)."""
-    inner = make_apply_fn(module)
-    mean = jnp.asarray(data.IMAGENET_MEAN, jnp.float32)
-    std = jnp.asarray(data.IMAGENET_STD, jnp.float32)
+def _normalizing_apply_fn(module):
+    """uint8 NHWC batches normalised on device — the
+    ``BatchTransformDataLoader.process_tensors`` trick (`dataloader.py:92-99`)
+    via the shared adapter."""
+    from tpu_compressed_dp.models.common import make_normalizing_apply_fn
 
-    def apply_fn(params, batch_stats, x, train, rngs):
-        x = (x.astype(jnp.float32) - mean) / std
-        return inner(params, batch_stats, x, train, rngs)
-
-    return apply_fn
+    return make_normalizing_apply_fn(module, data.IMAGENET_MEAN, data.IMAGENET_STD)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -309,7 +303,7 @@ def run(args) -> Dict[str, float]:
     first_sz = int(pd.phases[0]["sz"])
     params, stats = init_model(module, jax.random.key(args.seed % (2**31)),
                                jnp.zeros((1, first_sz, first_sz, 3), jnp.float32))
-    apply_fn = make_normalizing_apply_fn(module)
+    apply_fn = _normalizing_apply_fn(module)
 
     opt = SGD(
         lr=lr_sched, momentum=args.momentum, nesterov=False,

@@ -14,13 +14,34 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["init_model", "make_apply_fn"]
+__all__ = ["init_model", "make_apply_fn", "make_normalizing_apply_fn"]
 
 
 def init_model(module, rng: jax.Array, sample_input: jax.Array) -> Tuple[Any, Any]:
     """Initialise a model; returns ``(params, batch_stats)`` (stats may be {})."""
     variables = module.init({"params": rng, "dropout": rng}, sample_input, train=False)
     return variables["params"], variables.get("batch_stats", {})
+
+
+def make_normalizing_apply_fn(module, mean, std):
+    """``make_apply_fn`` with on-device input normalisation.
+
+    Loaders ship raw uint8 NHWC and the compiled step does ``(x - mean)/std``
+    (``mean``/``std`` on the 0-255 scale): 1 byte/pixel crosses the
+    host->device wire instead of 4 — the reference's GPU-side
+    ``BatchTransformDataLoader`` trick (`IMAGENET/training/dataloader.py:76-99`)
+    applied framework-wide."""
+    import jax.numpy as jnp
+
+    inner = make_apply_fn(module)
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+
+    def apply_fn(params, batch_stats, x, train, rngs):
+        x = (x.astype(jnp.float32) - mean) / std
+        return inner(params, batch_stats, x, train, rngs)
+
+    return apply_fn
 
 
 def make_apply_fn(module):
