@@ -57,6 +57,11 @@ class CompressionConfig:
     error_feedback: keep the dropped residual and re-add next step
                    (`sparsified_ddp.py:408-413`); the reference only has this
                    in RandomKSparsifiedDDP — here it composes with any method.
+                   NB (benchmarks/convergence_r1.txt): EF theory assumes
+                   plain SGD; Random-K + EF + momentum can diverge (the
+                   residual re-injects the large coordinates Top-K would
+                   have sent, and momentum amplifies them) — use momentum=0
+                   with randomk+EF, or Top-K, which keeps residuals small.
     shared_mask:   random masks identical across workers (shared-seed trick,
                    `sparsified_ddp.py:164`).  Defaults: False for 'simulate'
                    (the unseeded CIFAR harness draws per-rank masks), True is
