@@ -195,3 +195,55 @@ class TestLMTrainStep:
                              n_kv_heads=3, ffn_hidden=64, dtype=jnp.float32)
         with pytest.raises(ValueError, match="divide"):
             make_lm_train_step(cfg, SGD(lr=0.1), CompressionConfig(), _mesh(2, 2, 2))
+
+
+class TestRemat:
+    def test_remat_identical_forward_and_grads(self):
+        import dataclasses
+
+        cfg = tf.LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                             n_kv_heads=2, ffn_hidden=64, dtype=jnp.float32)
+        cfg_r = dataclasses.replace(cfg, remat=True)
+        params = tf.init_llama(cfg, jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 16), 0, 64)
+        tgts = jax.random.randint(jax.random.key(2), (2, 16), 0, 64)
+
+        def loss(c):
+            return lambda p: tf.vocab_parallel_xent(tf.apply_llama(c, p, toks), tgts)
+
+        l0, g0 = jax.value_and_grad(loss(cfg))(params)
+        l1, g1 = jax.value_and_grad(loss(cfg_r))(params)
+        assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                    atol=1e-5, rtol=1e-5),
+            g0, g1)
+
+    def test_remat_in_sharded_step(self):
+        import dataclasses
+        from tpu_compressed_dp.parallel.dp import CompressionConfig
+        from tpu_compressed_dp.train.lm_step import (
+            init_lm_ef_state, make_lm_mesh, make_lm_train_step,
+        )
+        from tpu_compressed_dp.train.optim import SGD
+        from tpu_compressed_dp.train.state import TrainState
+
+        cfg = tf.LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                             n_kv_heads=2, ffn_hidden=64, dtype=jnp.float32,
+                             remat=True)
+        mesh = make_lm_mesh(2, 2, 2)
+        params = tf.init_llama(cfg, jax.random.key(0))
+        opt = SGD(lr=0.1, momentum=0.9)
+        comp = CompressionConfig(method="topk", granularity="entiremodel",
+                                 ratio=0.05, error_feedback=True)
+        state = TrainState.create(params, {}, opt.init(params),
+                                  init_lm_ef_state(cfg, params, comp, mesh),
+                                  jax.random.key(1))
+        step = make_lm_train_step(cfg, opt, comp, mesh)
+        batch = {"input": jax.random.randint(jax.random.key(2), (4, 16), 0, 64),
+                 "target": jax.random.randint(jax.random.key(3), (4, 16), 0, 64)}
+        losses = []
+        for _ in range(5):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]

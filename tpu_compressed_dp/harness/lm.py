@@ -57,6 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_every", type=int, default=None)
     p.add_argument("--capacity_factor", type=float, default=None)
     p.add_argument("--fp32", action="store_true", help="disable bf16 compute")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialise layers in backward (jax.checkpoint)")
     # mesh
     p.add_argument("--dp", type=int, default=None, help="data axis size (default: all devices)")
     p.add_argument("--sp", type=int, default=1, help="sequence axis size")
@@ -108,6 +110,8 @@ def build_config(args) -> tf.LlamaConfig:
             overrides[field] = arg
     if args.fp32:
         overrides["dtype"] = jnp.float32
+    if args.remat:
+        overrides["remat"] = True
     return dataclasses.replace(cfg, **overrides)
 
 
