@@ -209,13 +209,20 @@ def run(args) -> Dict[str, float]:
     tokens_done = 0.0
     summary: Dict[str, float] = {}
     start = int(state.step)
+    timed_from = start
     for step_i in range(start, args.steps):
         batch = ds.batch(step_i)
         state, metrics = train_step(
             state, {k: jnp.asarray(v) for k, v in batch.items()})
+        if step_i == start:
+            # steady-state tokens/sec: exclude the first step's compile
+            jax.device_get(metrics)
+            t0 = time.time()
+            timed_from = step_i + 1
         if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
             m = jax.device_get(metrics)
-            tokens_done = (step_i + 1 - start) * args.global_batch * args.seq_len
+            tokens_done = max(step_i + 1 - timed_from, 1) * \
+                args.global_batch * args.seq_len
             dt = time.time() - t0
             summary = {
                 "step": step_i + 1,
