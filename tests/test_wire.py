@@ -252,3 +252,14 @@ class TestCheckSync:
         agree = shard_map(f, mesh=mesh8, in_specs=P("data"),
                           out_specs=P("data"))(jnp.ones((8, 4096)))
         assert float(jnp.min(agree)) == 0.0
+
+
+def test_packed_indices_underfull_mask_degrades_benignly():
+    """Ranks beyond the mask's true count fill with index 0, matching
+    jnp.nonzero(size=, fill_value=0) (the documented precondition guard)."""
+    from tpu_compressed_dp.ops.wire import packed_indices_from_mask
+
+    mask = jnp.zeros((1000,), bool).at[jnp.array([3, 500, 999])].set(True)
+    idx = packed_indices_from_mask(mask, 8)
+    np.testing.assert_array_equal(
+        np.asarray(idx), np.asarray(jnp.nonzero(mask, size=8, fill_value=0)[0]))

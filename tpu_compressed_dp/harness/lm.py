@@ -221,14 +221,15 @@ def run(args) -> Dict[str, float]:
             timed_from = step_i + 1
         if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
             m = jax.device_get(metrics)
-            tokens_done = max(step_i + 1 - timed_from, 1) * \
-                args.global_batch * args.seq_len
+            steps_timed = step_i + 1 - timed_from
+            tokens_done = steps_timed * args.global_batch * args.seq_len
             dt = time.time() - t0
             summary = {
                 "step": step_i + 1,
                 "loss": float(m["loss"]),
                 "lr": float(m["lr"]),
-                "tok/s": round(tokens_done / dt, 1),
+                # 0.0 until at least one post-compile step is in the window
+                "tok/s": round(tokens_done / dt, 1) if steps_timed > 0 else 0.0,
             }
             if "comm/sent_elems" in m:
                 summary["sent frac"] = float(m["comm/sent_elems"]) / max(

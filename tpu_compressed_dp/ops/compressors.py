@@ -120,7 +120,7 @@ def top_k(g: Array, key: Optional[Array] = None, *, ratio: float) -> Array:
     g = _flat(g)
     n = g.shape[0]
     keep = topk_keep_count(n, ratio)
-    mag = jnp.abs(g)
+    mag = jnp.abs(g).astype(jnp.float32)  # threshold compare in fp32 always
     # Threshold = smallest of the `keep` largest magnitudes.  Dispatches to
     # the Pallas histogram-select kernel at gradient scale on TPU (avoids
     # lax.top_k's full sort); exact top_k otherwise.

@@ -204,10 +204,13 @@ def topk_threshold(mag: Array, keep: int) -> Array:
     """
     n = mag.shape[0]
     if keep >= n:
-        return jnp.zeros((), mag.dtype)
+        return jnp.zeros((), jnp.float32)
     if _dispatch_to_pallas(n):
-        return _topk_threshold_pallas(mag, keep).astype(mag.dtype)
-    return jax.lax.top_k(mag, keep)[0][-1]
+        # fp32 always: downcasting the bin edge to a lower-precision input
+        # dtype could round UP past the true k-th magnitude and break the
+        # count(mag >= t) >= keep guarantee
+        return _topk_threshold_pallas(mag, keep)
+    return jax.lax.top_k(mag.astype(jnp.float32), keep)[0][-1]
 
 
 # ---------------------------------------------------------------------------

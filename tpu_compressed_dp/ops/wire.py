@@ -66,7 +66,11 @@ except ImportError:  # pragma: no cover - older/newer jax layouts
 
 
 def packed_indices_from_mask(mask: Array, keep: int) -> Array:
-    """Ascending indices of the ``keep`` True positions of ``mask``.
+    """Ascending indices of the first ``keep`` True positions of ``mask``.
+
+    Precondition: the mask should have at least ``keep`` set bits; ranks
+    beyond the actual count degrade benignly to index 0 (the same fill
+    ``jnp.nonzero(size=keep, fill_value=0)`` used).
 
     ``jnp.nonzero(size=)`` and a flat 1-D cumsum both lower poorly on TPU at
     gradient scale (~400ms / ~190ms at 42M elements).  Hierarchical stream
@@ -83,6 +87,8 @@ def packed_indices_from_mask(mask: Array, keep: int) -> Array:
     row_ends = jnp.cumsum(row_counts)                      # inclusive offsets
     ranks = jnp.arange(1, keep + 1, dtype=jnp.int32)
     row_of = jnp.searchsorted(row_ends, ranks, side="left")  # row per query
+    valid = row_of < m2.shape[0]                           # rank <= total count
+    row_of = jnp.where(valid, row_of, 0)
     # rank within the row: global rank minus everything before the row
     row_starts = row_ends[row_of] - row_counts[row_of]
     within = ranks - row_starts                             # 1-based in-row rank
@@ -91,7 +97,7 @@ def packed_indices_from_mask(mask: Array, keep: int) -> Array:
     prefix = rows @ tri.T                                   # inclusive prefix
     hit = (prefix >= within[:, None].astype(jnp.float32)) & (rows > 0)
     col = jnp.argmax(hit, axis=1).astype(jnp.int32)
-    return row_of * lanes + col
+    return jnp.where(valid, row_of * lanes + col, 0)
 
 
 def _randomk_indices(key: Array, n: int, keep: int) -> Array:
@@ -123,12 +129,14 @@ def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world
 
 
 def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world):
-    # threshold-select + hierarchical pack instead of lax.top_k's full sort
-    # (ties at the threshold resolve by lowest index, matching lax.top_k's
-    # stable order up to intra-tie membership)
+    # threshold-select + hierarchical pack instead of lax.top_k's full sort;
+    # near-threshold membership can differ from exact top-k by a few elements
+    # at the histogram's final-bin resolution (error feedback reabsorbs the
+    # difference).  fp32 magnitudes keep the count >= keep guarantee that
+    # packed_indices_from_mask requires.
     from tpu_compressed_dp.ops import kernels
 
-    mag = jnp.abs(flat)
+    mag = jnp.abs(flat).astype(jnp.float32)
     t = kernels.topk_threshold(mag, keep)
     idx = packed_indices_from_mask(mag >= t, keep)
     payload = flat[idx]                                   # [k] values + [k] indices travel
