@@ -8,6 +8,12 @@ anywhere: real chips, or CPU emulation via
     python examples/quickstart.py
 """
 
+import os
+import sys
+
+# runnable from a fresh checkout without installing the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -140,3 +140,46 @@ class TestRegistry:
             f = jax.jit(lambda g, k, b=b: b.fn(g, k))
             out = f(rand_grad(128), jax.random.key(3))
             assert out.shape == (128,)
+
+
+class TestBlockTopK:
+    """Net-new TPU-native operator (no reference equivalent): contiguous
+    ``block_size``-element blocks selected by L2 norm."""
+
+    def test_keep_block_count(self):
+        for n, k, bs in [(1024, 0.25, 64), (1000, 0.1, 128), (64, 0.5, 16), (100, 0.01, 32)]:
+            g = rand_grad(n, seed=n)
+            out = np.asarray(C.block_top_k(g, ratio=k, block_size=bs))
+            nb = -(-n // bs)
+            blocks = np.flatnonzero([np.any(out[i * bs:(i + 1) * bs]) for i in range(nb)])
+            assert len(blocks) == C.blocktopk_keep_blocks(n, k, bs)
+
+    def test_keeps_highest_norm_blocks(self):
+        bs = 4
+        g = jnp.asarray([0.1] * 4 + [5.0] * 4 + [0.2] * 4 + [1.0] * 4, jnp.float32)
+        out = np.asarray(C.block_top_k(g, ratio=0.5, block_size=bs))
+        np.testing.assert_allclose(out, [0.0] * 4 + [5.0] * 4 + [0.0] * 4 + [1.0] * 4)
+
+    def test_kept_values_unchanged_and_contiguous(self):
+        g = rand_grad(512, seed=3)
+        out = np.asarray(C.block_top_k(g, ratio=0.1, block_size=32))
+        mask = out != 0
+        np.testing.assert_array_equal(out[mask], np.asarray(g)[mask])
+        # survivors come in whole 32-element blocks
+        m2 = mask.reshape(-1, 32)
+        assert np.all(m2.all(axis=1) | (~m2).any(axis=1))
+        per_block = m2.any(axis=1)
+        np.testing.assert_array_equal(m2[per_block], np.ones_like(m2[per_block]))
+
+    def test_ragged_tail_block(self):
+        # n not divisible by block_size: the tail block competes with its
+        # zero-padding included in the score
+        g = jnp.concatenate([jnp.ones((96,)), jnp.full((10,), 10.0)]).astype(jnp.float32)
+        out = np.asarray(C.block_top_k(g, ratio=0.3, block_size=32))
+        assert np.count_nonzero(out[96:]) == 10  # tail block selected
+        assert out.shape == (106,)
+
+    def test_registry_and_payload(self):
+        b = C.get_compressor("blocktopk", ratio=0.25, block_size=64)
+        assert b.name == "blocktopk" and b.is_sparsifier and not b.needs_rng
+        assert C.payload_bits_per_elem("blocktopk", block_size=64) == 32.0 + 0.5

@@ -96,6 +96,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ratio", "-K", type=float, default=0.5)
     p.add_argument("--threshold", "-V", type=float, default=0.001)
     p.add_argument("--qstates", "-Q", type=int, default=255)
+    p.add_argument("--block_size", type=int, default=256,
+                   help="blocktopk: elements per contiguous block")
     p.add_argument("--momentum", type=float, default=0.0)
     p.add_argument("--mode", type=str, default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
@@ -188,6 +190,7 @@ def run(args) -> dict:
         ratio=args.ratio,
         threshold=args.threshold,
         qstates=args.qstates,
+        block_size=args.block_size,
         error_feedback=args.error_feedback,
     )
 

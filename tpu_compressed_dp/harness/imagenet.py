@@ -225,6 +225,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ratio", "-K", type=float, default=0.5)
     p.add_argument("--threshold", "-V", type=float, default=0.001)
     p.add_argument("--qstates", "-Q", type=int, default=255)
+    p.add_argument("--block_size", type=int, default=256,
+                   help="blocktopk: elements per contiguous block")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--devices", type=int, default=None)
@@ -314,7 +316,8 @@ def run(args) -> Dict[str, float]:
         method=None if args.compress == "none" or args.method.lower() == "none" else args.method,
         granularity=args.compress if args.compress != "none" else "layerwise",
         mode=args.mode, ratio=args.ratio, threshold=args.threshold,
-        qstates=args.qstates, error_feedback=args.error_feedback,
+        qstates=args.qstates, block_size=args.block_size,
+        error_feedback=args.error_feedback,
     )
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp, ndev),

@@ -49,6 +49,10 @@ __all__ = [
     "REGISTRY",
     "topk_keep_count",
     "randomk_keep_count",
+    "block_top_k",
+    "blocktopk_scores",
+    "blocktopk_num_blocks",
+    "blocktopk_keep_blocks",
     "terngrad_levels",
     "qsgd_levels",
     "leaf_key",
@@ -89,6 +93,53 @@ def randomk_keep_count(n: int, ratio: float) -> int:
     import math
 
     return max(0, min(n, int(math.ceil(n * ratio - 1e-9))))
+
+
+def blocktopk_num_blocks(n: int, block_size: int) -> int:
+    return -(-n // block_size)
+
+
+def blocktopk_keep_blocks(n: int, ratio: float, block_size: int) -> int:
+    """Blocks Block-Top-K keeps: ``ceil(num_blocks * ratio)``, at least 1."""
+    import math
+
+    nb = blocktopk_num_blocks(n, block_size)
+    return max(1, min(nb, int(math.ceil(nb * ratio - 1e-9))))
+
+
+def blocktopk_scores(g: Array, block_size: int) -> Array:
+    """Per-block squared-L2 scores of a flat vector (zero-padded to blocks).
+
+    Squared norms — sqrt is monotone, so the selected set is identical and
+    the threshold kernel's fp32 compare stays exact on nonnegative input.
+    """
+    g = _flat(g)
+    pad = (-g.shape[0]) % block_size
+    g2 = jnp.pad(g.astype(jnp.float32), (0, pad)).reshape(-1, block_size)
+    return jnp.sum(g2 * g2, axis=1)
+
+
+def block_top_k(g: Array, key: Optional[Array] = None, *, ratio: float,
+                block_size: int = 256) -> Array:
+    """Keep the ``~ratio`` fraction of contiguous ``block_size``-element blocks
+    with the largest L2 norm; zero the rest.
+
+    No reference equivalent — a TPU-native operator added because element-wise
+    Top-K's wire form needs per-element stream compaction, while whole blocks
+    gather/scatter as contiguous lane-aligned rows (no packing problem) and
+    their indices cost 32/block_size bits per element.  Same contraction-style
+    guarantees as Top-K for error feedback: it keeps at least as much mass as
+    Random-K at equal ratio, and EF reabsorbs what the block granularity drops.
+    """
+    g = _flat(g)
+    n = g.shape[0]
+    keep = blocktopk_keep_blocks(n, ratio, block_size)
+    scores = blocktopk_scores(g, block_size)
+    from tpu_compressed_dp.ops import kernels
+
+    thresh = kernels.topk_threshold(scores, keep)
+    mask = jnp.repeat(scores >= thresh, block_size)[:n]
+    return jnp.where(mask, g, 0.0)
 
 
 def identity(g: Array, key: Optional[Array] = None) -> Array:
@@ -259,11 +310,13 @@ class _Bound:
     def is_sparsifier(self) -> bool:
         """Sparsifiers send only the surviving coordinates; quantizers
         (terngrad/qsgd) and identity send every coordinate at reduced width."""
-        return self.name in ("topk", "randomk", "thresholdv", "adaptive_threshold")
+        return self.name in ("topk", "randomk", "thresholdv",
+                             "adaptive_threshold", "blocktopk")
 
 
 def payload_bits_per_elem(
-    name: str, *, qstates: int = 255, shared_mask: bool = False
+    name: str, *, qstates: int = 255, shared_mask: bool = False,
+    block_size: int = 256
 ) -> float:
     """Analytic wire width of one transmitted element, in bits.
 
@@ -273,6 +326,8 @@ def payload_bits_per_elem(
       * sparsifier: 32-bit value + 32-bit index, except shared-seed Random-K
         whose indices are implied by the common PRNG key
         (`sparsified_ddp.py:164` — only k values travel, `:412`);
+      * Block-Top-K: 32-bit value + one 32-bit block index per block_size
+        elements;
       * TernGrad: 2 bits per element (3 levels) + one fp32 scale (amortised);
       * QSGD/random dithering: sign + ceil(log2(qstates+1)) level bits + one
         fp32 norm (amortised) — the QSGD paper's variable-length bound is
@@ -284,6 +339,8 @@ def payload_bits_per_elem(
         return 32.0 if name == "none" else 64.0
     if name == "randomk":
         return 32.0 if shared_mask else 64.0
+    if name == "blocktopk":
+        return 32.0 + 32.0 / block_size
     if name == "terngrad":
         return 2.0
     if name == "qsgd":
@@ -296,6 +353,9 @@ def payload_bits_per_elem(
 # TernGrad / RandomDithering.
 _ALIASES = {
     "topk": "topk",
+    "blocktopk": "blocktopk",
+    "block_topk": "blocktopk",
+    "blocktop_k": "blocktopk",
     "randomk": "randomk",
     "thresholdv": "thresholdv",
     "adaptivethreshold": "adaptive_threshold",
@@ -308,7 +368,8 @@ _ALIASES = {
     "dense": "none",
 }
 
-REGISTRY = ("none", "topk", "randomk", "thresholdv", "adaptive_threshold", "terngrad", "qsgd")
+REGISTRY = ("none", "topk", "blocktopk", "randomk", "thresholdv",
+            "adaptive_threshold", "terngrad", "qsgd")
 
 
 def get_compressor(
@@ -317,6 +378,7 @@ def get_compressor(
     ratio: float = 0.5,
     threshold: float = 1e-3,
     qstates: int = 255,
+    block_size: int = 256,
 ) -> _Bound:
     """Resolve a method name (canonical or reference spelling) to a bound op.
 
@@ -333,6 +395,12 @@ def get_compressor(
         return _Bound("none", lambda g, key=None: identity(g), needs_rng=False)
     if canon == "topk":
         return _Bound("topk", lambda g, key=None: top_k(g, key, ratio=ratio), needs_rng=False)
+    if canon == "blocktopk":
+        return _Bound(
+            "blocktopk",
+            lambda g, key=None: block_top_k(g, key, ratio=ratio, block_size=block_size),
+            needs_rng=False,
+        )
     if canon == "randomk":
         return _Bound("randomk", lambda g, key: random_k(g, key, ratio=ratio), needs_rng=True)
     if canon == "thresholdv":

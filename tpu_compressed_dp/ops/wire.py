@@ -7,7 +7,7 @@ reduction bucket (`IMAGENET/training/sparsified_ddp.py:412,460-462`) and
 relying on a shared RNG seed so every rank picks the same indices
 (`sparsified_ddp.py:164`).  This module is the TPU-native generalisation of
 that path (``mode='wire'`` of :class:`~tpu_compressed_dp.parallel.dp.CompressionConfig`),
-covering four of the six operators:
+covering four of the six reference operators plus the net-new Block-Top-K:
 
   * **Random-K** (the `RandomKSparsifiedDDP` equivalent): a PRNG key shared by
     all workers selects identical coordinates; only the k surviving *values*
@@ -22,6 +22,12 @@ covering four of the six operators:
     elements are kept per worker (fixed-size for XLA); the simulate path's
     keep-all-ties semantics (`core.py:181-183`) can keep a few more — the two
     modes agree whenever ``|g|`` has no ties at the threshold.
+  * **Block-Top-K** (net-new, no reference equivalent): element Top-K's wire
+    form needs per-element stream compaction of the full gradient; selecting
+    whole contiguous blocks by L2 norm instead moves the compaction onto the
+    ~n/block_size block *scores*, and the payload — ``[kb, block_size]``
+    value rows + ``[kb]`` block indices — gathers/scatters as contiguous
+    lane-aligned rows.  The TPU-native fast path among the sparsifiers.
   * **TernGrad**: per-worker ternary levels packed to int8 (wire width 8 bits;
     the information content is the 2 bits/elem the analytic accounting
     reports) plus one fp32 scale, combined via ``all_gather``.
@@ -53,7 +59,7 @@ Array = jax.Array
 
 __all__ = ["make_wire_grad_sync", "WIRE_METHODS"]
 
-WIRE_METHODS = ("randomk", "topk", "terngrad", "qsgd")
+WIRE_METHODS = ("randomk", "topk", "blocktopk", "terngrad", "qsgd")
 
 try:
     # The gathered payload is identical on every worker; the *_invariant
@@ -75,9 +81,13 @@ def packed_indices_from_mask(mask: Array, keep: int) -> Array:
     ``jnp.nonzero(size=)`` and a flat 1-D cumsum both lower poorly on TPU at
     gradient scale (~400ms / ~190ms at 42M elements).  Hierarchical stream
     compaction instead: per-128-lane-row counts (one linear reduce), a small
-    cumsum over row totals, a ``searchsorted`` to find each selected
-    element's row, then an in-row prefix via a lower-triangular matmul on the
-    gathered rows — every stage linear or MXU-shaped (~25ms at 42M).
+    cumsum over row totals, a rank→row map, then an in-row prefix via a
+    lower-triangular matmul on the gathered rows — every stage linear or
+    MXU-shaped.  The rank→row map is ``searchsorted(row_ends, rank)`` in
+    spirit, but since the queries are exactly the consecutive ranks
+    ``1..keep``, it is computed by bucketing each row's inclusive end and
+    prefix-summing — ``#{i : row_ends[i] < r}`` — which replaced the
+    binary search's serialized gather chain (258ms → ~25ms at 170M).
     """
     lanes = 128
     n = mask.shape[0]
@@ -86,7 +96,9 @@ def packed_indices_from_mask(mask: Array, keep: int) -> Array:
     row_counts = jnp.sum(m2, axis=1, dtype=jnp.int32)
     row_ends = jnp.cumsum(row_counts)                      # inclusive offsets
     ranks = jnp.arange(1, keep + 1, dtype=jnp.int32)
-    row_of = jnp.searchsorted(row_ends, ranks, side="left")  # row per query
+    # row_of[r-1] = #{i : row_ends[i] < r}  (== searchsorted(row_ends, r, left))
+    ends_hist = jnp.zeros((keep + 1,), jnp.int32).at[jnp.minimum(row_ends, keep)].add(1)
+    row_of = jnp.cumsum(ends_hist)[:keep]
     valid = row_of < m2.shape[0]                           # rank <= total count
     row_of = jnp.where(valid, row_of, 0)
     # rank within the row: global rank minus everything before the row
@@ -116,7 +128,6 @@ def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world
     # device-varying manifest-axes tag of the local gradient and defeat
     # shard_map's replication inference for the psum-reduced result.
     dense = jnp.zeros(flat.shape, flat.dtype).at[idx].set(reduced)
-    local_dense = jnp.zeros_like(flat).at[idx].set(payload)
     agree = None
     if check:
         # `check_reduction` analog: all workers must have selected the SAME
@@ -125,7 +136,7 @@ def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world
                                else jnp.float32) * (1.0 + jnp.arange(keep) % 7))
         agree = (jax.lax.pmax(h, axis_name) == jax.lax.pmin(h, axis_name)
                  ).astype(jnp.float32)
-    return dense, local_dense, agree
+    return dense, idx, agree
 
 
 def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world):
@@ -148,8 +159,41 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world):
         .add(g_vals.reshape(-1))
         / world
     )
-    local_dense = jnp.zeros_like(flat).at[idx].set(payload)
-    return dense, local_dense
+    return dense, idx
+
+
+def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
+                         axis_name: str, world, want_ef: bool):
+    """Block-granular Top-K: whole contiguous blocks travel.
+
+    The TPU-native fast path — selected blocks gather/scatter as contiguous
+    lane-aligned rows, so there is no per-element stream compaction at all:
+    the pack runs on the ~n/block_size block scores instead of n elements.
+    Payload per worker: ``[keep_blocks, block_size]`` values +
+    ``[keep_blocks]`` int32 block indices, all_gather-combined (worker-local
+    block sets differ, as with element Top-K).
+    """
+    from tpu_compressed_dp.ops import kernels
+
+    n = flat.shape[0]
+    pad = (-n) % block_size
+    g2 = jnp.pad(flat, (0, pad)).reshape(-1, block_size)
+    x = g2.astype(jnp.float32)
+    scores = jnp.sum(x * x, axis=1)            # == compressors.blocktopk_scores
+    t = kernels.topk_threshold(scores, keep_blocks)
+    bidx = packed_indices_from_mask(scores >= t, keep_blocks)
+    payload = g2[bidx]                         # [kb, bs] contiguous rows
+    g_vals = _all_gather(payload, axis_name)   # [W, kb, bs]
+    g_idx = _all_gather(bidx, axis_name)       # [W, kb]
+    dense2 = (
+        jnp.zeros(g2.shape, flat.dtype)
+        .at[g_idx.reshape(-1)]
+        .add(g_vals.reshape(-1, block_size))
+        / world
+    )
+    dense = dense2.reshape(-1)[:n]
+    new_ef = g2.at[bidx].set(0.0).reshape(-1)[:n] if want_ef else None
+    return dense, new_ef
 
 
 def _leaf_sync_terngrad(flat: Array, key: Array, axis_name: str, world):
@@ -176,7 +220,8 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
     here for ``mode='wire'``); must run inside ``shard_map`` over ``axis_name``.
     """
     comp = compressors.get_compressor(
-        cfg.method, ratio=cfg.ratio, threshold=cfg.threshold, qstates=cfg.qstates
+        cfg.method, ratio=cfg.ratio, threshold=cfg.threshold,
+        qstates=cfg.qstates, block_size=cfg.block_size,
     )
     if comp.name not in WIRE_METHODS:
         raise NotImplementedError(
@@ -195,7 +240,8 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         )
 
     bits_per_elem = compressors.payload_bits_per_elem(
-        comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask
+        comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask,
+        block_size=cfg.block_size,
     )
     # Quantizer dither may (and, for variance reduction, should) differ across
     # workers: honour shared_mask=False the same way simulate mode does.
@@ -207,24 +253,57 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             return compressors.topk_keep_count(n, cfg.ratio)
         if comp.name == "randomk":
             return compressors.randomk_keep_count(n, cfg.ratio)
+        if comp.name == "blocktopk":
+            # whole blocks travel, pad zeros included — honest wire size;
+            # capped at n: when every block is kept (small leaves round up
+            # to >= 1 block) the leaf psums dense instead, with no payload
+            # inflation from block padding
+            kb = compressors.blocktopk_keep_blocks(n, cfg.ratio, cfg.block_size)
+            return min(kb * cfg.block_size, n)
         return n  # quantizers transmit every coordinate (at reduced width)
 
     check = getattr(cfg, "check_sync", False)
+
+    def leaf_bits(n: int, keep: int) -> float:
+        # blocktopk's dense-fallback leaves (keep == n) carry no block
+        # indices — plain fp32 values — so don't bill the index overhead
+        if comp.name == "blocktopk" and keep >= n:
+            return keep * 32.0
+        return keep * bits_per_elem
 
     def sync_flat(flat: Array, ef_flat, key: Array, world):
         acc = flat + ef_flat if ef_flat is not None else flat
         keep = leaf_keep(flat.shape[0])
         agree = None
+        idx = None
         if comp.name == "randomk":
-            dense, local_dense, agree = _leaf_sync_randomk(
+            dense, idx, agree = _leaf_sync_randomk(
                 acc, key, keep, axis_name, world, check)
         elif comp.name == "topk":
-            dense, local_dense = _leaf_sync_topk(acc, keep, axis_name, world)
+            dense, idx = _leaf_sync_topk(acc, keep, axis_name, world)
+        elif comp.name == "blocktopk":
+            if keep >= flat.shape[0]:
+                # every block selected (leaves <= block_size always are, and
+                # ratio~1 configs): identical to simulate mode's keep-all
+                # result, and a dense psum is strictly cheaper than padded
+                # block rows — matches the reference protocol of never
+                # sending more than the dense tensor
+                dense = jax.lax.psum(acc, axis_name) / world
+                new_ef = jnp.zeros_like(acc) if ef_flat is not None else None
+            else:
+                dense, new_ef = _leaf_sync_blocktopk(
+                    acc, keep // cfg.block_size, cfg.block_size, axis_name,
+                    world, ef_flat is not None)
+            return dense, new_ef, keep, agree
         elif comp.name == "terngrad":
-            dense, local_dense = _leaf_sync_terngrad(acc, key, axis_name, world), acc
+            dense = _leaf_sync_terngrad(acc, key, axis_name, world)
         else:  # qsgd
-            dense, local_dense = _leaf_sync_qsgd(acc, key, cfg.qstates, axis_name, world), acc
-        new_ef = acc - local_dense if ef_flat is not None else None
+            dense = _leaf_sync_qsgd(acc, key, cfg.qstates, axis_name, world)
+        # EF residual = the coordinates that did NOT travel; zeroing the sent
+        # ones in place of building a dense local reconstruction saves a full
+        # scatter + elementwise pass at model scale.  EF with quantizers is
+        # rejected at build time, so ef_flat != None implies a sparsifier.
+        new_ef = acc.at[idx].set(0) if ef_flat is not None else None
         return dense, new_ef, keep, agree
 
     def sync(grads: Any, ef: Any, key: Array) -> Tuple[Any, Any, Dict[str, Array]]:
@@ -238,7 +317,7 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             dense, new_ef_flat, keep, agree = sync_flat(flat, ef_flat, k0, world)
             stats = {
                 "sent_elems": jnp.asarray(float(keep), jnp.float32),
-                "sent_bits": jnp.asarray(keep * bits_per_elem, jnp.float32),
+                "sent_bits": jnp.asarray(leaf_bits(flat.shape[0], keep), jnp.float32),
                 "dense_elems": jnp.asarray(float(flat.shape[0]), jnp.float32),
                 "num_collectives": jnp.asarray(1.0, jnp.float32),
             }
@@ -250,6 +329,7 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         ef_leaves = jax.tree.leaves(ef) if use_ef else [None] * len(leaves)
         out_leaves, new_ef_leaves, agrees = [], [], []
         sent = 0.0
+        bits = 0.0
         dense_total = 0.0
         for i, (g, e) in enumerate(zip(leaves, ef_leaves)):
             flat = g.reshape(-1)
@@ -262,11 +342,12 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             if agree is not None:
                 agrees.append(agree)
             sent += float(keep)
+            bits += leaf_bits(flat.shape[0], keep)
             dense_total += float(flat.shape[0])
 
         stats = {
             "sent_elems": jnp.asarray(sent, jnp.float32),
-            "sent_bits": jnp.asarray(sent * bits_per_elem, jnp.float32),
+            "sent_bits": jnp.asarray(bits, jnp.float32),
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
             "num_collectives": jnp.asarray(float(len(leaves)), jnp.float32),
         }

@@ -45,8 +45,11 @@ __all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
 class CompressionConfig:
     """Mirrors the reference CLI surface (`dawn.py:15-19`, `train_imagenet_nv.py`).
 
-    method:        none | topk | randomk | thresholdv | adaptive_threshold |
-                   terngrad | qsgd  (reference spellings accepted)
+    method:        none | topk | blocktopk | randomk | thresholdv |
+                   adaptive_threshold | terngrad | qsgd  (reference spellings
+                   accepted; blocktopk is net-new — contiguous-block Top-K by
+                   block L2 norm, the TPU-native fast wire path, see
+                   :mod:`tpu_compressed_dp.ops.wire`)
     granularity:   'layerwise' (one op + one reduce per parameter tensor) or
                    'entiremodel' (flatten the whole gradient, one op + reduce)
     mode:          'simulate' (dense payload, paper protocol) or 'wire'
@@ -82,6 +85,7 @@ class CompressionConfig:
     error_feedback: bool = False
     shared_mask: Optional[bool] = None
     check_sync: bool = False
+    block_size: int = 256  # blocktopk: elements per contiguous block
 
     def __post_init__(self):
         if self.granularity not in ("layerwise", "entiremodel"):
@@ -131,7 +135,8 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     uncompressed size.
     """
     comp = compressors.get_compressor(
-        cfg.method, ratio=cfg.ratio, threshold=cfg.threshold, qstates=cfg.qstates
+        cfg.method, ratio=cfg.ratio, threshold=cfg.threshold,
+        qstates=cfg.qstates, block_size=cfg.block_size,
     )
     if cfg.mode == "wire" and comp.name != "none":
         # Dense (method=None) has no sparse representation — the simulate
@@ -141,7 +146,8 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         return wire.make_wire_grad_sync(cfg, axis_name)
     per_worker_rng = not cfg.resolved_shared_mask
     bits_per_elem = compressors.payload_bits_per_elem(
-        comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask
+        comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask,
+        block_size=cfg.block_size,
     )
 
     def sent_count(comp_flat: jax.Array) -> jax.Array:
@@ -150,6 +156,13 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         # per-element width accounted by `bits_per_elem`.
         if not comp.is_sparsifier:
             return jnp.asarray(float(comp_flat.shape[0]), jnp.float32)
+        if comp.name == "blocktopk":
+            # whole blocks travel (zeros inside a selected block included);
+            # capped at n — the wire path psums small/keep-all leaves dense
+            kb = compressors.blocktopk_keep_blocks(
+                comp_flat.shape[0], cfg.ratio, cfg.block_size)
+            return jnp.asarray(
+                float(min(kb * cfg.block_size, comp_flat.shape[0])), jnp.float32)
         return jnp.count_nonzero(comp_flat).astype(jnp.float32)
 
     def compress_flat(flat: jax.Array, key: jax.Array, index: int) -> jax.Array:
