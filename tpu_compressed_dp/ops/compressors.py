@@ -50,6 +50,7 @@ __all__ = [
     "topk_keep_count",
     "randomk_keep_count",
     "block_top_k",
+    "blocktopk_blocks",
     "blocktopk_scores",
     "blocktopk_num_blocks",
     "blocktopk_keep_blocks",
@@ -107,16 +108,24 @@ def blocktopk_keep_blocks(n: int, ratio: float, block_size: int) -> int:
     return max(1, min(nb, int(math.ceil(nb * ratio - 1e-9))))
 
 
+def blocktopk_blocks(g: Array, block_size: int) -> Array:
+    """Zero-padded ``[num_blocks, block_size]`` view of a flat vector."""
+    g = _flat(g)
+    pad = (-g.shape[0]) % block_size
+    return jnp.pad(g, (0, pad)).reshape(-1, block_size)
+
+
 def blocktopk_scores(g: Array, block_size: int) -> Array:
     """Per-block squared-L2 scores of a flat vector (zero-padded to blocks).
 
     Squared norms — sqrt is monotone, so the selected set is identical and
     the threshold kernel's fp32 compare stays exact on nonnegative input.
+    The single source of truth for block selection: the wire path
+    (:func:`tpu_compressed_dp.ops.wire._leaf_sync_blocktopk`) calls this
+    same function, so wire and simulate modes can never diverge on scoring.
     """
-    g = _flat(g)
-    pad = (-g.shape[0]) % block_size
-    g2 = jnp.pad(g.astype(jnp.float32), (0, pad)).reshape(-1, block_size)
-    return jnp.sum(g2 * g2, axis=1)
+    x = blocktopk_blocks(_flat(g).astype(jnp.float32), block_size)
+    return jnp.sum(x * x, axis=1)
 
 
 def block_top_k(g: Array, key: Optional[Array] = None, *, ratio: float,

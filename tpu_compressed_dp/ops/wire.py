@@ -176,10 +176,8 @@ def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
     from tpu_compressed_dp.ops import kernels
 
     n = flat.shape[0]
-    pad = (-n) % block_size
-    g2 = jnp.pad(flat, (0, pad)).reshape(-1, block_size)
-    x = g2.astype(jnp.float32)
-    scores = jnp.sum(x * x, axis=1)            # == compressors.blocktopk_scores
+    g2 = compressors.blocktopk_blocks(flat, block_size)
+    scores = compressors.blocktopk_scores(flat, block_size)
     t = kernels.topk_threshold(scores, keep_blocks)
     bidx = packed_indices_from_mask(scores >= t, keep_blocks)
     payload = g2[bidx]                         # [kb, bs] contiguous rows

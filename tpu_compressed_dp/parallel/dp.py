@@ -165,6 +165,17 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
                 float(min(kb * cfg.block_size, comp_flat.shape[0])), jnp.float32)
         return jnp.count_nonzero(comp_flat).astype(jnp.float32)
 
+    def sent_bits(comp_flat: jax.Array, sent: jax.Array) -> jax.Array:
+        # blocktopk's keep-all/small leaves psum dense on the wire — no
+        # block indices travel — so bill them 32 bits/elem, matching the
+        # wire path's leaf_bits (stats agree exactly across modes)
+        if comp.name == "blocktopk":
+            n = comp_flat.shape[0]
+            kb = compressors.blocktopk_keep_blocks(n, cfg.ratio, cfg.block_size)
+            width = 32.0 if kb * cfg.block_size >= n else bits_per_elem
+            return sent * width
+        return sent * bits_per_elem
+
     def compress_flat(flat: jax.Array, key: jax.Array, index: int) -> jax.Array:
         k = compressors.leaf_key(key, index, per_worker_rng and comp.needs_rng, axis_name)
         return comp.fn(flat, k)
@@ -190,7 +201,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             new_ef = unravel(new_ef_flat) if use_ef else ()
             stats = {
                 "sent_elems": sent,
-                "sent_bits": sent * bits_per_elem,
+                "sent_bits": sent_bits(comp_flat, sent),
                 "dense_elems": jnp.asarray(float(flat.shape[0]), jnp.float32),
                 "num_collectives": jnp.asarray(1.0, jnp.float32),
             }
@@ -200,6 +211,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         # collective) per parameter tensor — `core.py:176`.  The per-leaf
         # psums are left unfused; XLA coalesces/schedules them.
         out_leaves, new_ef_leaves, sent_total = [], [], jnp.asarray(0.0, jnp.float32)
+        bits_total = jnp.asarray(0.0, jnp.float32)
         dense_total = 0.0
         for i, (g, e) in enumerate(zip(leaves, ef_leaves)):
             flat = g.reshape(-1)
@@ -209,14 +221,16 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
                 new_ef_leaves.append((acc - comp_flat).reshape(g.shape))
             reduced = jax.lax.psum(comp_flat, axis_name) / world
             out_leaves.append(reduced.reshape(g.shape))
-            sent_total = sent_total + sent_count(comp_flat)
+            leaf_sent = sent_count(comp_flat)
+            sent_total = sent_total + leaf_sent
+            bits_total = bits_total + sent_bits(comp_flat, leaf_sent)
             dense_total += float(flat.shape[0])
 
         out = jax.tree.unflatten(treedef, out_leaves)
         new_ef = jax.tree.unflatten(treedef, new_ef_leaves) if use_ef else ()
         stats = {
             "sent_elems": sent_total,
-            "sent_bits": sent_total * bits_per_elem,
+            "sent_bits": bits_total,
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
             "num_collectives": jnp.asarray(float(len(leaves)), jnp.float32),
         }
