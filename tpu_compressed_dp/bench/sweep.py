@@ -188,7 +188,7 @@ def run_sweep(args) -> List[Dict[str, float]]:
     print(f"# dense baseline: {args.model}", file=sys.stderr)
     emit(run_point(method=None, **{**common, "error_feedback": False}))
     for method, gran in itertools.product(methods, grans):
-        pts = ratios if method in ("topk", "randomk") else [None]
+        pts = ratios if method in ("topk", "randomk", "blocktopk") else [None]
         for ratio in pts:
             label = f"{method}/{gran}" + (f"/k={ratio}" if ratio is not None else "")
             print(f"# {label}", file=sys.stderr)
@@ -211,10 +211,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="compression sweep benchmark")
     p.add_argument("--model", default="resnet9")
     p.add_argument("--methods", default="topk,randomk",
-                   help="comma list; full set: topk,randomk,thresholdv,"
-                        "adaptive_threshold,terngrad,qsgd")
+                   help="comma list; full set: topk,blocktopk,randomk,"
+                        "thresholdv,adaptive_threshold,terngrad,qsgd")
     p.add_argument("--ratios", default="0.001,0.01,0.1",
-                   help="k values for topk/randomk (paper: 0.1%%,1%%,10%%)")
+                   help="k values for topk/blocktopk/randomk (paper: 0.1%%,1%%,10%%)")
     p.add_argument("--granularities", default="layerwise,entiremodel")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--qstates", type=int, default=255)
