@@ -131,3 +131,70 @@ class TestErrorFeedback:
         out2, ef2, _ = run_sync(mesh8, cfg, grads, ef=ef1, seed=1)
         # residual keeps growing for untransmitted coords
         assert float(jnp.max(ef2["w"])) >= float(jnp.max(ef1["w"]))
+
+
+class TestBucketedGranularity:
+    """granularity='bucketed': the reference DDP's static 25MB bucketing
+    (`ddp.py:188,238-241`) — contiguous leaves concatenated into capped
+    groups, one operator + one collective per bucket."""
+
+    def test_make_leaf_groups(self):
+        from tpu_compressed_dp.parallel.dp import make_leaf_groups
+
+        sizes = [100, 100, 300, 50, 600, 10]
+        # capacity 800 bytes = 200 fp32 elems
+        groups = make_leaf_groups(sizes, "bucketed", 800.0)
+        assert groups == [[0, 1], [2], [3], [4], [5]]
+        assert make_leaf_groups(sizes, "layerwise", 800.0) == [[i] for i in range(6)]
+        assert make_leaf_groups(sizes, "entiremodel", 800.0) == [list(range(6))]
+        assert make_leaf_groups([], "entiremodel", 800.0) == []
+        # oversized single leaf still gets its own bucket
+        assert make_leaf_groups([10**9], "bucketed", 800.0) == [[0]]
+
+    def test_dense_bucketed_equals_layerwise(self, mesh8):
+        grads = make_grads()
+        cfg_b = CompressionConfig(method=None, granularity="bucketed", bucket_mb=1e-4)
+        cfg_l = CompressionConfig(method=None, granularity="layerwise")
+        out_b, _, stats_b = run_sync(mesh8, cfg_b, grads)
+        out_l, _, _ = run_sync(mesh8, cfg_l, grads)
+        for leaf in out_b:
+            np.testing.assert_allclose(
+                np.asarray(out_b[leaf]), np.asarray(out_l[leaf]), rtol=1e-6)
+
+    def test_bucket_count_and_collectives(self, mesh8):
+        # leaves: w 64 elems (256B), b 8 elems (32B); capacity 256B -> 2 buckets
+        grads = make_grads()
+        cfg = CompressionConfig(method="topk", ratio=0.25, granularity="bucketed",
+                                bucket_mb=256 / 1e6, shared_mask=True)
+        _, _, stats = run_sync(mesh8, cfg, grads)
+        assert float(stats["num_collectives"]) == 2.0
+        # huge capacity -> one bucket, entiremodel-equivalent selection
+        cfg1 = CompressionConfig(method="topk", ratio=0.25, granularity="bucketed",
+                                 bucket_mb=25.0, shared_mask=True)
+        out1, _, stats1 = run_sync(mesh8, cfg1, grads)
+        cfg_e = CompressionConfig(method="topk", ratio=0.25, granularity="entiremodel",
+                                  shared_mask=True)
+        out_e, _, _ = run_sync(mesh8, cfg_e, grads)
+        assert float(stats1["num_collectives"]) == 1.0
+        for leaf in out1:
+            np.testing.assert_allclose(
+                np.asarray(out1[leaf]), np.asarray(out_e[leaf]), rtol=1e-6)
+
+    def test_ef_residual_identity_bucketed(self, mesh8):
+        # residual + transmitted == accumulated gradient, per worker
+        grads = make_grads()
+        cfg = CompressionConfig(method="topk", ratio=0.25, granularity="bucketed",
+                                bucket_mb=256 / 1e6, error_feedback=True)
+        out, ef1, _ = run_sync(mesh8, cfg, grads)
+        from tpu_compressed_dp.ops.compressors import topk_keep_count
+
+        g0 = np.asarray(grads["w"])[0]
+        k = topk_keep_count(64, 0.25)
+        idx = np.argsort(-np.abs(g0))[:k]
+        exp_res = g0.copy()
+        exp_res[idx] = 0.0
+        np.testing.assert_allclose(np.asarray(ef1["w"]), exp_res, rtol=1e-5)
+
+    def test_rejects_bad_bucket_mb(self):
+        with pytest.raises(ValueError, match="bucket_mb"):
+            CompressionConfig(method="topk", granularity="bucketed", bucket_mb=0.0)

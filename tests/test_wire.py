@@ -337,3 +337,33 @@ class TestBlockTopKWire:
         np.testing.assert_allclose(np.asarray(out["small"]),
                                    np.asarray(grads["small"])[0], rtol=1e-6)
         np.testing.assert_allclose(np.asarray(ef1["small"]), np.zeros(10))
+
+
+class TestBucketedWire:
+    def test_bucketed_wire_matches_simulate(self, mesh8):
+        # multi-leaf buckets through the wire path: same grouping and keys as
+        # simulate mode, so shared-mask randomk agrees exactly
+        grads = make_grads()
+        kw = dict(method="randomk", ratio=0.25, granularity="bucketed",
+                  bucket_mb=256 / 1e6, shared_mask=True)
+        out_s, _, _ = run_sync(mesh8, CompressionConfig(mode="simulate", **kw), grads)
+        out_w, _, stats = run_sync(mesh8, CompressionConfig(mode="wire", **kw), grads)
+        for leaf in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(out_s[leaf]), np.asarray(out_w[leaf]), rtol=1e-6)
+        assert float(stats["num_collectives"]) == 2.0
+        assert float(stats["sent_elems"]) < float(stats["dense_elems"])
+
+    def test_bucketed_wire_ef_topk(self, mesh8):
+        grads = make_grads()
+        cfg = CompressionConfig(method="topk", ratio=0.25, granularity="bucketed",
+                                bucket_mb=256 / 1e6, mode="wire", error_feedback=True)
+        out, ef1, _ = run_sync(mesh8, cfg, grads)
+        from tpu_compressed_dp.ops.compressors import topk_keep_count
+
+        g0 = np.asarray(grads["w"])[0]
+        k = topk_keep_count(64, 0.25)
+        idx = np.argsort(-np.abs(g0))[:k]
+        exp_res = g0.copy()
+        exp_res[idx] = 0.0
+        np.testing.assert_allclose(np.asarray(ef1["w"]), exp_res, rtol=1e-5)

@@ -72,6 +72,7 @@ def run_point(
     ratio: float = 0.01,
     qstates: int = 255,
     block_size: int = 256,
+    bucket_mb: float = 25.0,
     error_feedback: bool = False,
     batch_size: int = 512,
     image_size: int = 128,
@@ -94,7 +95,8 @@ def run_point(
     opt = SGD(lr=0.01, momentum=0.9, weight_decay=5e-4)
     cfg = CompressionConfig(
         method=method, granularity=granularity, mode=mode, ratio=ratio,
-        qstates=qstates, block_size=block_size, error_feedback=error_feedback,
+        qstates=qstates, block_size=block_size, bucket_mb=bucket_mb,
+        error_feedback=error_feedback,
     )
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, cfg, ndev),
@@ -183,6 +185,7 @@ def run_sweep(args) -> List[Dict[str, float]]:
         num_classes=args.num_classes, steps=args.steps, warmup=args.warmup,
         devices=args.devices, mode=args.mode, qstates=args.qstates,
         block_size=args.block_size,
+        bucket_mb=args.bucket_mb,
         error_feedback=args.error_feedback,
     )
     print(f"# dense baseline: {args.model}", file=sys.stderr)
@@ -219,6 +222,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--qstates", type=int, default=255)
     p.add_argument("--block_size", type=int, default=256)
+    p.add_argument("--bucket_mb", type=float, default=25.0)
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--batch_size", type=int, default=512)
     p.add_argument("--image_size", type=int, default=128,

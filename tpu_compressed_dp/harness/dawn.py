@@ -91,13 +91,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log_dir", type=str, default=".")
     p.add_argument("--network", "-n", type=str, default="resnet9", choices=sorted(MODELS))
     p.add_argument("--compress", "-c", type=str, default="none",
-                   choices=["none", "layerwise", "entiremodel"])
+                   choices=["none", "layerwise", "entiremodel", "bucketed"])
     p.add_argument("--method", type=str, default="none")
     p.add_argument("--ratio", "-K", type=float, default=0.5)
     p.add_argument("--threshold", "-V", type=float, default=0.001)
     p.add_argument("--qstates", "-Q", type=int, default=255)
     p.add_argument("--block_size", type=int, default=256,
                    help="blocktopk: elements per contiguous block")
+    p.add_argument("--bucket_mb", type=float, default=25.0,
+                   help="bucketed granularity: capacity per bucket")
     p.add_argument("--momentum", type=float, default=0.0)
     p.add_argument("--mode", type=str, default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
@@ -191,6 +193,7 @@ def run(args) -> dict:
         threshold=args.threshold,
         qstates=args.qstates,
         block_size=args.block_size,
+        bucket_mb=args.bucket_mb,
         error_feedback=args.error_feedback,
     )
 

@@ -220,13 +220,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_bn_wd", action="store_true", help="exclude BN params from wd")
     p.add_argument("--init_bn0", action="store_true", help="zero-init last-BN gammas")
     p.add_argument("--fp32", action="store_true", help="disable bf16 compute")
-    p.add_argument("--compress", "-c", default="none", choices=["none", "layerwise", "entiremodel"])
+    p.add_argument("--compress", "-c", default="none", choices=["none", "layerwise", "entiremodel", "bucketed"])
     p.add_argument("--method", default="none")
     p.add_argument("--ratio", "-K", type=float, default=0.5)
     p.add_argument("--threshold", "-V", type=float, default=0.001)
     p.add_argument("--qstates", "-Q", type=int, default=255)
     p.add_argument("--block_size", type=int, default=256,
                    help="blocktopk: elements per contiguous block")
+    p.add_argument("--bucket_mb", type=float, default=25.0,
+                   help="bucketed granularity: capacity per bucket")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--devices", type=int, default=None)
@@ -317,6 +319,7 @@ def run(args) -> Dict[str, float]:
         granularity=args.compress if args.compress != "none" else "layerwise",
         mode=args.mode, ratio=args.ratio, threshold=args.threshold,
         qstates=args.qstates, block_size=args.block_size,
+        bucket_mb=args.bucket_mb,
         error_feedback=args.error_feedback,
     )
     state = TrainState.create(

@@ -51,7 +51,6 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.flatten_util import ravel_pytree
 
 from tpu_compressed_dp.ops import compressors
 
@@ -305,38 +304,34 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         return dense, new_ef, keep, agree
 
     def sync(grads: Any, ef: Any, key: Array) -> Tuple[Any, Any, Dict[str, Array]]:
+        from tpu_compressed_dp.parallel.dp import (
+            BUCKET_MB, group_concat, group_split, make_leaf_groups,
+        )
+
         world = jax.lax.psum(1, axis_name)
         use_ef = cfg.error_feedback
-
-        if cfg.granularity == "entiremodel":
-            flat, unravel = ravel_pytree(grads)
-            ef_flat = ravel_pytree(ef)[0] if use_ef else None
-            k0 = compressors.leaf_key(key, 0, per_worker_rng, axis_name)
-            dense, new_ef_flat, keep, agree = sync_flat(flat, ef_flat, k0, world)
-            stats = {
-                "sent_elems": jnp.asarray(float(keep), jnp.float32),
-                "sent_bits": jnp.asarray(leaf_bits(flat.shape[0], keep), jnp.float32),
-                "dense_elems": jnp.asarray(float(flat.shape[0]), jnp.float32),
-                "num_collectives": jnp.asarray(1.0, jnp.float32),
-            }
-            if agree is not None:
-                stats["sync_agree"] = agree
-            return unravel(dense), (unravel(new_ef_flat) if use_ef else ()), stats
-
         leaves, treedef = jax.tree.flatten(grads)
         ef_leaves = jax.tree.leaves(ef) if use_ef else [None] * len(leaves)
-        out_leaves, new_ef_leaves, agrees = [], [], []
+
+        # One packed payload + one collective per group (layerwise /
+        # entiremodel / 25MB-bucketed — the same static grouping as
+        # simulate mode, parallel/dp.py:make_leaf_groups).
+        groups = make_leaf_groups(
+            [g.size for g in leaves], cfg.granularity, cfg.bucket_mb * BUCKET_MB)
+        out_leaves = [None] * len(leaves)
+        new_ef_leaves = [None] * len(leaves)
+        agrees = []
         sent = 0.0
         bits = 0.0
         dense_total = 0.0
-        for i, (g, e) in enumerate(zip(leaves, ef_leaves)):
-            flat = g.reshape(-1)
-            ef_flat = e.reshape(-1) if use_ef else None
-            ki = compressors.leaf_key(key, i, per_worker_rng, axis_name)
+        for gi, idxs in enumerate(groups):
+            flat = group_concat(leaves, idxs)
+            ef_flat = group_concat(ef_leaves, idxs) if use_ef else None
+            ki = compressors.leaf_key(key, gi, per_worker_rng, axis_name)
             dense, new_ef_flat, keep, agree = sync_flat(flat, ef_flat, ki, world)
-            out_leaves.append(dense.reshape(g.shape))
+            group_split(dense, leaves, idxs, out_leaves)
             if use_ef:
-                new_ef_leaves.append(new_ef_flat.reshape(g.shape))
+                group_split(new_ef_flat, leaves, idxs, new_ef_leaves)
             if agree is not None:
                 agrees.append(agree)
             sent += float(keep)
@@ -347,7 +342,7 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             "sent_elems": jnp.asarray(sent, jnp.float32),
             "sent_bits": jnp.asarray(bits, jnp.float32),
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
-            "num_collectives": jnp.asarray(float(len(leaves)), jnp.float32),
+            "num_collectives": jnp.asarray(float(len(groups)), jnp.float32),
         }
         if agrees:
             stats["sync_agree"] = jnp.min(jnp.stack(agrees))

@@ -33,12 +33,11 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.flatten_util import ravel_pytree
 
 from tpu_compressed_dp.ops import compressors
 
 __all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
-           "init_ef_state"]
+           "make_leaf_groups", "group_concat", "group_split", "init_ef_state"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,8 +49,14 @@ class CompressionConfig:
                    accepted; blocktopk is net-new — contiguous-block Top-K by
                    block L2 norm, the TPU-native fast wire path, see
                    :mod:`tpu_compressed_dp.ops.wire`)
-    granularity:   'layerwise' (one op + one reduce per parameter tensor) or
-                   'entiremodel' (flatten the whole gradient, one op + reduce)
+    granularity:   'layerwise' (one op + one reduce per parameter tensor),
+                   'entiremodel' (flatten the whole gradient, one op + reduce),
+                   or 'bucketed' (contiguous parameter tensors concatenated
+                   into <= bucket_mb groups, one op + reduce per bucket — the
+                   reference DDP's 25 MB bucketing, `ddp.py:188,238-241`,
+                   computed statically at trace time)
+    bucket_mb:     bucket capacity for granularity='bucketed' (default 25,
+                   matching the reference)
     mode:          'simulate' (dense payload, paper protocol) or 'wire'
                    (packed sparse payload)
     ratio:         K for topk/randomk (`--ratio`, default 0.5)
@@ -86,10 +91,14 @@ class CompressionConfig:
     shared_mask: Optional[bool] = None
     check_sync: bool = False
     block_size: int = 256  # blocktopk: elements per contiguous block
+    bucket_mb: float = 25.0  # bucketed: capacity per bucket (ddp.py:188)
 
     def __post_init__(self):
-        if self.granularity not in ("layerwise", "entiremodel"):
-            raise ValueError(f"granularity must be layerwise|entiremodel, got {self.granularity!r}")
+        if self.granularity not in ("layerwise", "entiremodel", "bucketed"):
+            raise ValueError(
+                f"granularity must be layerwise|entiremodel|bucketed, got {self.granularity!r}")
+        if self.bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be positive, got {self.bucket_mb}")
         if self.mode not in ("simulate", "wire"):
             raise ValueError(f"mode must be simulate|wire, got {self.mode!r}")
 
@@ -116,6 +125,56 @@ def init_ef_state(grads_like: Any, cfg: CompressionConfig, num_devices: Optional
     return jax.tree.map(
         lambda g: jnp.zeros((num_devices,) + g.shape, dtype=jnp.float32), grads_like
     )
+
+
+# The reference's bucket unit is MiB: ``bucket_bytes_cap = bucket_cap_mb *
+# 1024 * 1024`` (`ddp.py:182,188`).
+BUCKET_MB = 1024.0 * 1024.0
+
+
+def make_leaf_groups(sizes, granularity: str, bucket_bytes: float):
+    """Partition leaf indices into reduction groups, statically at trace time.
+
+    'layerwise' -> one leaf per group (one collective per parameter,
+    `core.py:176`); 'entiremodel' -> every leaf in one group (`core.py:229`);
+    'bucketed' -> contiguous leaves greedily packed into <= ``bucket_bytes``
+    fp32 groups, the static equivalent of the reference DDP's
+    ``_dist_bucket_tensors(..., 25MB)`` C++ bucketing (`ddp.py:188,238`);
+    an oversized single leaf gets its own bucket.
+    """
+    n = len(sizes)
+    if granularity == "layerwise":
+        return [[i] for i in range(n)]
+    if granularity == "entiremodel":
+        return [list(range(n))] if n else []
+    groups, cur, cur_bytes = [], [], 0.0
+    for i, sz in enumerate(sizes):
+        b = 4.0 * sz
+        if cur and cur_bytes + b > bucket_bytes:
+            groups.append(cur)
+            cur, cur_bytes = [], 0.0
+        cur.append(i)
+        cur_bytes += b
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def group_concat(leaves, idxs):
+    """Flatten-and-concatenate a reduction group's leaves (single-leaf groups
+    skip the concat)."""
+    flats = [leaves[i].reshape(-1) for i in idxs]
+    return flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+
+
+def group_split(flat, leaves, idxs, out):
+    """Slice a group's flat result back into per-leaf shapes, writing into
+    ``out`` at the original leaf positions."""
+    off = 0
+    for i in idxs:
+        n = leaves[i].size
+        out[i] = flat[off:off + n].reshape(leaves[i].shape)
+        off += n
 
 
 def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
@@ -186,44 +245,29 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         use_ef = cfg.error_feedback
         ef_leaves = jax.tree.leaves(ef) if use_ef else [None] * len(leaves)
 
-        if cfg.granularity == "entiremodel":
-            flat, unravel = ravel_pytree(grads)
-            if use_ef:
-                ef_flat, _ = ravel_pytree(ef)
-                acc = flat + ef_flat
-            else:
-                acc = flat
-            comp_flat = compress_flat(acc, key, 0)
-            new_ef_flat = acc - comp_flat
-            reduced = jax.lax.psum(comp_flat, axis_name) / world
-            sent = sent_count(comp_flat)
-            out = unravel(reduced)
-            new_ef = unravel(new_ef_flat) if use_ef else ()
-            stats = {
-                "sent_elems": sent,
-                "sent_bits": sent_bits(comp_flat, sent),
-                "dense_elems": jnp.asarray(float(flat.shape[0]), jnp.float32),
-                "num_collectives": jnp.asarray(1.0, jnp.float32),
-            }
-            return out, new_ef, stats
-
-        # layerwise: one operator application (and, conceptually, one
-        # collective) per parameter tensor — `core.py:176`.  The per-leaf
-        # psums are left unfused; XLA coalesces/schedules them.
-        out_leaves, new_ef_leaves, sent_total = [], [], jnp.asarray(0.0, jnp.float32)
+        # One operator application + one collective per group: layerwise =
+        # per parameter tensor (`core.py:176`), entiremodel = the whole
+        # flattened gradient (`core.py:229`), bucketed = the reference DDP's
+        # static 25 MB buckets.  Per-group psums are left unfused; XLA
+        # coalesces/schedules them.
+        groups = make_leaf_groups(
+            [g.size for g in leaves], cfg.granularity, cfg.bucket_mb * BUCKET_MB)
+        out_leaves = [None] * len(leaves)
+        new_ef_leaves = [None] * len(leaves)
+        sent_total = jnp.asarray(0.0, jnp.float32)
         bits_total = jnp.asarray(0.0, jnp.float32)
         dense_total = 0.0
-        for i, (g, e) in enumerate(zip(leaves, ef_leaves)):
-            flat = g.reshape(-1)
-            acc = flat + e.reshape(-1) if use_ef else flat
-            comp_flat = compress_flat(acc, key, i)
-            if use_ef:
-                new_ef_leaves.append((acc - comp_flat).reshape(g.shape))
+        for gi, idxs in enumerate(groups):
+            flat = group_concat(leaves, idxs)
+            acc = flat + group_concat(ef_leaves, idxs) if use_ef else flat
+            comp_flat = compress_flat(acc, key, gi)
             reduced = jax.lax.psum(comp_flat, axis_name) / world
-            out_leaves.append(reduced.reshape(g.shape))
-            leaf_sent = sent_count(comp_flat)
-            sent_total = sent_total + leaf_sent
-            bits_total = bits_total + sent_bits(comp_flat, leaf_sent)
+            group_split(reduced, leaves, idxs, out_leaves)
+            if use_ef:
+                group_split(acc - comp_flat, leaves, idxs, new_ef_leaves)
+            group_sent = sent_count(comp_flat)
+            sent_total = sent_total + group_sent
+            bits_total = bits_total + sent_bits(comp_flat, group_sent)
             dense_total += float(flat.shape[0])
 
         out = jax.tree.unflatten(treedef, out_leaves)
@@ -232,7 +276,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             "sent_elems": sent_total,
             "sent_bits": bits_total,
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
-            "num_collectives": jnp.asarray(float(len(leaves)), jnp.float32),
+            "num_collectives": jnp.asarray(float(len(groups)), jnp.float32),
         }
         return out, new_ef, stats
 
