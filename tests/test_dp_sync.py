@@ -141,8 +141,9 @@ class TestBucketedGranularity:
     def test_make_leaf_groups(self):
         from tpu_compressed_dp.parallel.dp import make_leaf_groups
 
-        sizes = [100, 100, 300, 50, 600, 10]
-        # capacity 800 bytes = 200 fp32 elems
+        # byte sizes (size * itemsize), ADVICE r1: bf16 leaves pack at their
+        # real density, not a hardcoded 4 bytes/elem
+        sizes = [400, 400, 1200, 200, 2400, 40]
         groups = make_leaf_groups(sizes, "bucketed", 800.0)
         assert groups == [[0, 1], [2], [3], [4], [5]]
         assert make_leaf_groups(sizes, "layerwise", 800.0) == [[i] for i in range(6)]
@@ -150,6 +151,24 @@ class TestBucketedGranularity:
         assert make_leaf_groups([], "entiremodel", 800.0) == []
         # oversized single leaf still gets its own bucket
         assert make_leaf_groups([10**9], "bucketed", 800.0) == [[0]]
+        # half-width leaves fill a bucket at twice the element count
+        assert make_leaf_groups([400, 400, 400, 400], "bucketed", 800.0) == [
+            [0, 1], [2, 3]]
+
+    def test_mixed_dtype_group_keeps_leaf_dtypes_and_fp32_ef(self, mesh8):
+        # ADVICE r1: concatenating bf16+fp32 leaves promotes; the synced
+        # grads must come back at each leaf's dtype while the EF residual
+        # stays fp32 (sub-bf16-epsilon dropped mass must accumulate).
+        k = jax.random.key(3)
+        grads = {
+            "a": jax.random.normal(k, (8, 48), jnp.float32).astype(jnp.bfloat16),
+            "b": jax.random.normal(jax.random.fold_in(k, 1), (8, 32), jnp.float32),
+        }
+        cfg = CompressionConfig(method="topk", ratio=0.25, granularity="bucketed",
+                                bucket_mb=1e-3, error_feedback=True)
+        out, new_ef, _ = run_sync(mesh8, cfg, grads)
+        assert out["a"].dtype == jnp.bfloat16 and out["b"].dtype == jnp.float32
+        assert new_ef["a"].dtype == jnp.float32 and new_ef["b"].dtype == jnp.float32
 
     def test_dense_bucketed_equals_layerwise(self, mesh8):
         grads = make_grads()

@@ -1,0 +1,303 @@
+"""Bisect the Random-K + error-feedback + momentum divergence (VERDICT r1 #3).
+
+Round-1 observation (`benchmarks/convergence_r1.txt`): wire/simulate Random-K
+k=1% WITH error feedback diverges (NaN) under the dawn protocol's momentum-0.9
+Nesterov SGD, while Top-K+EF and Block-Top-K+EF converge, and momentum=0 or
+EF-off converge.  The reference trains its `RandomKSparsifiedDDP` (EF +
+Random-K, `IMAGENET/training/sparsified_ddp.py:408-413`) with momentum-0.9 SGD
+(`train_imagenet_nv.py:186-191`) — so either our composition differs, or the
+reference's would diverge under the same (CIFAR dawn, high peak lr, Nesterov)
+protocol too.
+
+This tool reproduces the dynamics small and fast — one worker, a 2-layer MLP
+on non-saturating synthetic data, jitted `lax.scan` over steps — and sweeps
+the suspects:
+
+  * momentum value (0 / 0.9)
+  * Nesterov on/off (dawn uses Nesterov, `dawn.py:146-148`; the ImageNet
+    harness uses plain momentum)
+  * EF accumulation style:
+      - 'plain'    residual += dropped gradient (the reference rule)
+      - 'momentum' DGC-style momentum-corrected EF (Lin et al., ICLR'18
+        "Deep Gradient Compression", PAPERS.md): accumulate the *velocity*
+        v = mu v + g instead of the raw gradient, send sparse(residual),
+        and apply the payload WITHOUT optimizer momentum — momentum lives
+        inside the compression stream, so delayed coordinates do not get
+        double-amplified by the optimizer's momentum buffer.
+  * method: randomk / topk (topk is the converging control)
+  * peak lr scale
+
+Also runs the same protocol through a *torch* implementation mirroring the
+reference's update rule (masked_select/masked_fill EF + torch.optim.SGD) to
+show whether the reference's own arithmetic shares the divergence.
+
+Usage:
+    python tools/ef_bisect.py            # full bisect table
+    python tools/ef_bisect.py --steps 640 --peak_lr 0.4
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def make_data(seed: int = 0, n: int = 4096, dim: int = 64, classes: int = 10,
+              noise_frac: float = 0.15):
+    """Teacher-labelled gaussian features + label noise: a task a small MLP
+    fits to ~90%, not 100% — gradients stay non-trivial all run."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, dim).astype(np.float32)
+    w_t = rng.randn(dim, classes).astype(np.float32)
+    y = np.argmax(x @ w_t + 0.5 * rng.randn(n, classes), axis=1)
+    flip = rng.rand(n) < noise_frac
+    y[flip] = rng.randint(0, classes, flip.sum())
+    return x, y.astype(np.int32)
+
+
+# ---------------------------------------------------------------- JAX side
+
+def run_jax(momentum: float, nesterov: bool, ef: bool, ef_style: str,
+            method: str, ratio: float, steps: int, peak_lr: float,
+            batch: int = 512, seed: int = 0, clip: float = 0.0,
+            warmup_sparsity: bool = False):
+    """Train the MLP under the dawn summed-loss protocol; return per-step loss."""
+    import jax
+    import jax.numpy as jnp
+
+    x_np, y_np = make_data(seed)
+    n, dim = x_np.shape
+    classes = int(y_np.max()) + 1
+    hidden = 128
+    rng = np.random.RandomState(seed + 1)
+    params = {
+        "w1": jnp.asarray(rng.randn(dim, hidden).astype(np.float32) / np.sqrt(dim)),
+        "w2": jnp.asarray(rng.randn(hidden, classes).astype(np.float32) / np.sqrt(hidden)),
+    }
+    x_all, y_all = jnp.asarray(x_np), jnp.asarray(y_np)
+
+    # dawn protocol scaling (`dawn.py:142-148`): summed loss, lr/bs, wd*bs
+    wd = 5e-4 * batch
+    warm = max(1, steps // 8)
+
+    def lr_at(step):
+        up = peak_lr * step / warm
+        down = peak_lr * (steps - step) / (steps - warm)
+        return jnp.maximum(jnp.where(step < warm, up, down), 0.0) / batch
+
+    def loss_fn(p, xb, yb):
+        h = jnp.maximum(xb @ p["w1"], 0.0)
+        logits = h @ p["w2"]
+        logz = jax.nn.log_softmax(logits)
+        return -jnp.sum(jnp.take_along_axis(logz, yb[:, None], 1))
+
+    flat_sizes = [int(v.size) for v in jax.tree.leaves(params)]
+
+    def compress(flat, key, step):
+        n_el = flat.shape[0]
+        if warmup_sparsity:
+            # DGC-style sparsity warm-up: keep-ratio decays exponentially
+            # from dense to the target over the first quarter of training
+            frac = jnp.clip(step / (steps / 4.0), 0.0, 1.0)
+            ratio_t = jnp.exp(jnp.log(1.0) * (1 - frac) + jnp.log(ratio) * frac)
+        else:
+            ratio_t = ratio
+        if method == "randomk":
+            if warmup_sparsity:
+                mask = jax.random.uniform(key, (n_el,)) < ratio_t
+            else:
+                k = max(1, int(round(ratio * n_el)))
+                idx = jax.random.permutation(key, n_el)[:k]
+                mask = jnp.zeros(n_el, bool).at[idx].set(True)
+        else:  # topk
+            k = max(1, int(round(ratio * n_el)))
+            t = jnp.sort(jnp.abs(flat))[n_el - k]
+            mask = jnp.abs(flat) >= t
+        return jnp.where(mask, flat, 0.0), mask
+
+    def step_fn(carry, step):
+        p, mom, resid, vel, key = carry
+        key, k1, k2 = jax.random.split(key, 3)
+        i = jax.random.randint(k1, (batch,), 0, n)
+        g = jax.grad(loss_fn)(p, x_all[i], y_all[i])
+
+        lr = lr_at(step)
+        new_p, new_mom, new_resid, new_vel = {}, {}, {}, {}
+        for name in p:
+            gl = g[name].reshape(-1)
+            if clip > 0:
+                # DGC-style gradient clipping before EF accumulation, in
+                # mean-loss units (gl is a summed-loss gradient)
+                gnorm = jnp.linalg.norm(gl) / batch
+                gl = gl * jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
+            if ef and ef_style == "ef21":
+                # EF21 (Richtarik et al., 2021): each worker keeps a gradient
+                # estimate h and transmits only the compressed *innovation*
+                # c = compress(g - h); h += c.  The optimizer consumes the
+                # smooth dense estimate h — momentum never sees delayed
+                # spikes, which is exactly what blows plain-EF Random-K up.
+                innov = gl - resid[name]              # resid doubles as h
+                sent, mask = compress(innov, jax.random.fold_in(k2, hash(name) % 997), step)
+                h = resid[name] + sent
+                d = h + wd * p[name].reshape(-1)
+                buf = momentum * mom[name] + d
+                upd = d + momentum * buf if nesterov else buf
+                new_p[name] = (p[name].reshape(-1) - lr * upd).reshape(p[name].shape)
+                new_mom[name] = buf
+                new_resid[name], new_vel[name] = h, vel[name]
+            elif ef and ef_style == "momentum":
+                # DGC (Lin et al.): velocity accumulates into the residual;
+                # the optimizer applies the sparse payload directly (no second
+                # momentum), and — critically — the *velocity is also masked*
+                # at sent coordinates ("momentum factor masking"), so stale
+                # momentum stops re-injecting directions that already shipped.
+                v = momentum * vel[name] + gl
+                acc = resid[name] + v
+                sent, mask = compress(acc, jax.random.fold_in(k2, hash(name) % 997), step)
+                r = jnp.where(mask, 0.0, acc)
+                v = jnp.where(mask, 0.0, v)
+                d = sent + wd * p[name].reshape(-1)
+                new_p[name] = (p[name].reshape(-1) - lr * d).reshape(p[name].shape)
+                new_mom[name] = mom[name]
+                new_resid[name], new_vel[name] = r, v
+            else:
+                acc = (resid[name] + gl) if ef else gl
+                sent, mask = compress(acc, jax.random.fold_in(k2, hash(name) % 997), step)
+                r = jnp.where(mask, 0.0, acc) if ef else resid[name]
+                d = sent + wd * p[name].reshape(-1)
+                buf = momentum * mom[name] + d
+                upd = d + momentum * buf if nesterov else buf
+                new_p[name] = (p[name].reshape(-1) - lr * upd).reshape(p[name].shape)
+                new_mom[name] = buf
+                new_resid[name], new_vel[name] = r, vel[name]
+        lval = loss_fn(p, x_all[i], y_all[i]) / batch
+        return (new_p, new_mom, new_resid, new_vel, key), lval
+
+    import jax
+    zeros = {k: jnp.zeros(v.size) for k, v in params.items()}
+    carry = (params, dict(zeros), dict(zeros), dict(zeros), jax.random.key(seed))
+    carry, losses = jax.lax.scan(step_fn, carry, jnp.arange(steps))
+    return np.asarray(losses)
+
+
+# -------------------------------------------------------------- torch side
+
+def run_torch(momentum: float, nesterov: bool, ratio: float, steps: int,
+              peak_lr: float, batch: int = 512, seed: int = 0):
+    """The reference's own arithmetic: per-parameter Random-K EF via
+    masked_select/masked_fill (`sparsified_ddp.py:408-413`) + torch.optim.SGD
+    momentum (`train_imagenet_nv.py:186-191`), world size 1."""
+    import torch
+
+    torch.manual_seed(seed)
+    x_np, y_np = make_data(seed)
+    x = torch.tensor(x_np)
+    y = torch.tensor(y_np, dtype=torch.long)
+    n, dim = x.shape
+    classes = int(y.max().item()) + 1
+    model = torch.nn.Sequential(
+        torch.nn.Linear(dim, 128, bias=False),
+        torch.nn.ReLU(),
+        torch.nn.Linear(128, classes, bias=False),
+    )
+    wd = 5e-4 * batch
+    opt = torch.optim.SGD(model.parameters(), lr=0.0, momentum=momentum,
+                          nesterov=nesterov and momentum > 0, weight_decay=wd)
+    crit = torch.nn.CrossEntropyLoss(reduction="sum")
+    warm = max(1, steps // 8)
+    eps = [torch.zeros(p.numel()) for p in model.parameters()]
+    gen = torch.Generator().manual_seed(2147483647)  # the reference seed
+    losses = []
+    for step in range(steps):
+        lr = max(min(peak_lr * step / warm,
+                     peak_lr * (steps - step) / (steps - warm)), 0.0) / batch
+        for gparam in opt.param_groups:
+            gparam["lr"] = lr
+        i = torch.randint(0, n, (batch,))
+        opt.zero_grad()
+        loss = crit(model(x[i]), y[i])
+        loss.backward()
+        with torch.no_grad():
+            for p, e in zip(model.parameters(), eps):
+                flat = p.grad.reshape(-1)
+                flat += e                                     # EF in
+                k = max(1, int(round(ratio * flat.numel())))
+                mask = torch.randperm(flat.numel(), generator=gen).lt(k)
+                e.copy_(flat.masked_fill(mask, 0))            # EF out
+                flat.mul_(mask)                               # sparse grad
+        opt.step()
+        losses.append(loss.item() / batch)
+        if not np.isfinite(losses[-1]):
+            break
+    return np.asarray(losses)
+
+
+def summarize(name: str, losses: np.ndarray) -> str:
+    bad = np.where(~np.isfinite(losses) | (losses > 1e4))[0]
+    if bad.size:
+        return (f"{name:58s} DIVERGED (loss non-finite/blown-up at step "
+                f"{bad[0]}/{len(losses)})")
+    return (f"{name:58s} ok   final={losses[-1]:.4f}  "
+            f"max={losses.max():.2f}  last10={losses[-10:].mean():.4f}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=640)
+    ap.add_argument("--peak_lr", type=float, default=0.4)
+    ap.add_argument("--ratio", type=float, default=0.01)
+    ap.add_argument("--skip_torch", action="store_true")
+    args = ap.parse_args(argv)
+
+    rows = []
+    cases = [
+        # (label, momentum, nesterov, ef, ef_style, method)
+        ("dense-ctl   mom=.9 nesterov", None, None, None, None, "dense"),
+        ("randomk+EF  mom=.9 nesterov  [r1 diverger]", 0.9, True, True, "plain", "randomk"),
+        ("randomk+EF  mom=.9 plain-momentum", 0.9, False, True, "plain", "randomk"),
+        ("randomk+EF  mom=0", 0.0, False, True, "plain", "randomk"),
+        ("randomk     mom=.9 nesterov  no-EF", 0.9, True, False, "plain", "randomk"),
+        ("topk+EF     mom=.9 nesterov  [r1 converger]", 0.9, True, True, "plain", "topk"),
+        ("randomk+EF-momentum(DGC) mu=.9", 0.9, False, True, "momentum", "randomk"),
+        ("randomk+EF21 mom=.9 nesterov", 0.9, True, True, "ef21", "randomk"),
+        ("topk+EF21    mom=.9 nesterov", 0.9, True, True, "ef21", "topk"),
+    ]
+    clip_cases = [
+        # (label, momentum, nesterov, ef_style, method, clip, warmup)
+        ("randomk+EF mom=.9 nesterov CLIP=1", 0.9, True, "plain", "randomk", 1.0, False),
+        ("randomk+EF mom=.9 nesterov CLIP=1 +WARMUP", 0.9, True, "plain", "randomk", 1.0, True),
+        ("randomk+EF mom=.9 nesterov WARMUP only", 0.9, True, "plain", "randomk", 0.0, True),
+        ("topk+EF    mom=.9 nesterov CLIP=1", 0.9, True, "plain", "topk", 1.0, False),
+    ]
+    for label, mom, nest, ef, style, method in cases:
+        if method == "dense":
+            losses = run_jax(0.9, True, False, "plain", "randomk", 1.0,
+                             args.steps, args.peak_lr)
+        else:
+            losses = run_jax(mom, nest, ef, style, method, args.ratio,
+                             args.steps, args.peak_lr)
+        rows.append(summarize(label, losses))
+        print(rows[-1], flush=True)
+    for label, mom, nest, style, method, clip, warm in clip_cases:
+        losses = run_jax(mom, nest, True, style, method, args.ratio,
+                         args.steps, args.peak_lr, clip=clip,
+                         warmup_sparsity=warm)
+        rows.append(summarize(label, losses))
+        print(rows[-1], flush=True)
+
+    if not args.skip_torch:
+        for label, mom, nest in [
+            ("TORCH reference-rule randomk+EF mom=.9 nesterov", 0.9, True),
+            ("TORCH reference-rule randomk+EF mom=.9 plain", 0.9, False),
+            ("TORCH reference-rule randomk+EF mom=0", 0.0, False),
+        ]:
+            losses = run_torch(mom, nest, args.ratio, args.steps, args.peak_lr)
+            rows.append(summarize(label, losses))
+            print(rows[-1], flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

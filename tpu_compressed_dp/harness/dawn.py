@@ -101,6 +101,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket_mb", type=float, default=25.0,
                    help="bucketed granularity: capacity per bucket")
     p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--clip_norm", type=float, default=0.0,
+                   help="local-gradient L2 clip (mean-loss units; 0=off) — the "
+                        "DGC-style stabiliser for EF + momentum (see "
+                        "tools/ef_bisect.py)")
     p.add_argument("--mode", type=str, default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--epochs", type=int, default=None, help="override the 24/40 rule")
@@ -206,7 +210,8 @@ def run(args) -> dict:
         mean=np.asarray(data.CIFAR10_MEAN) * 255.0,
         std=np.asarray(data.CIFAR10_STD) * 255.0,
     )
-    train_step = make_train_step(apply_fn, opt, comp, mesh, grad_scale=float(bs))
+    train_step = make_train_step(apply_fn, opt, comp, mesh, grad_scale=float(bs),
+                                 clip_norm=args.clip_norm)
     eval_step = make_eval_step(apply_fn, mesh)
 
     table, tsv = TableLogger(), TSVLogger()

@@ -317,7 +317,8 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         # entiremodel / 25MB-bucketed — the same static grouping as
         # simulate mode, parallel/dp.py:make_leaf_groups).
         groups = make_leaf_groups(
-            [g.size for g in leaves], cfg.granularity, cfg.bucket_mb * BUCKET_MB)
+            [g.size * g.dtype.itemsize for g in leaves],
+            cfg.granularity, cfg.bucket_mb * BUCKET_MB)
         out_leaves = [None] * len(leaves)
         new_ef_leaves = [None] * len(leaves)
         agrees = []
@@ -331,7 +332,9 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             dense, new_ef_flat, keep, agree = sync_flat(flat, ef_flat, ki, world)
             group_split(dense, leaves, idxs, out_leaves)
             if use_ef:
-                group_split(new_ef_flat, leaves, idxs, new_ef_leaves)
+                # EF residual is fp32 by design (see group_split docstring)
+                group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
+                            dtype=jnp.float32)
             if agree is not None:
                 agrees.append(agree)
             sent += float(keep)

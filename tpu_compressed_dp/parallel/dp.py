@@ -65,11 +65,13 @@ class CompressionConfig:
     error_feedback: keep the dropped residual and re-add next step
                    (`sparsified_ddp.py:408-413`); the reference only has this
                    in RandomKSparsifiedDDP — here it composes with any method.
-                   NB (benchmarks/convergence_r1.txt): EF theory assumes
-                   plain SGD; Random-K + EF + momentum can diverge (the
-                   residual re-injects the large coordinates Top-K would
-                   have sent, and momentum amplifies them) — use momentum=0
-                   with randomk+EF, or Top-K, which keeps residuals small.
+                   NB: EF defers ~1/k steps of gradient mass per coordinate;
+                   under momentum that delay diverges at high peak lr — for
+                   the reference's own update rule too (torch repro in
+                   tools/ef_bisect.py; results in
+                   benchmarks/ef_momentum_bisect_r2.txt).  Stabilise with the
+                   train step's ``clip_norm`` (DGC-style local-gradient
+                   clipping) or momentum=0.
     shared_mask:   random masks identical across workers (shared-seed trick,
                    `sparsified_ddp.py:164`).  Defaults: False for 'simulate'
                    (the unseeded CIFAR harness draws per-rank masks), True is
@@ -121,7 +123,10 @@ def init_ef_state(grads_like: Any, cfg: CompressionConfig, num_devices: Optional
     if not cfg.error_feedback:
         return ()
     if num_devices is None:
-        return jax.tree.map(jnp.zeros_like, grads_like)
+        # fp32 regardless of gradient dtype: sub-epsilon dropped mass must
+        # accumulate across steps, not round away (see group_split)
+        return jax.tree.map(
+            lambda g: jnp.zeros(g.shape, dtype=jnp.float32), grads_like)
     return jax.tree.map(
         lambda g: jnp.zeros((num_devices,) + g.shape, dtype=jnp.float32), grads_like
     )
@@ -132,24 +137,23 @@ def init_ef_state(grads_like: Any, cfg: CompressionConfig, num_devices: Optional
 BUCKET_MB = 1024.0 * 1024.0
 
 
-def make_leaf_groups(sizes, granularity: str, bucket_bytes: float):
+def make_leaf_groups(byte_sizes, granularity: str, bucket_bytes: float):
     """Partition leaf indices into reduction groups, statically at trace time.
 
     'layerwise' -> one leaf per group (one collective per parameter,
     `core.py:176`); 'entiremodel' -> every leaf in one group (`core.py:229`);
     'bucketed' -> contiguous leaves greedily packed into <= ``bucket_bytes``
-    fp32 groups, the static equivalent of the reference DDP's
-    ``_dist_bucket_tensors(..., 25MB)`` C++ bucketing (`ddp.py:188,238`);
-    an oversized single leaf gets its own bucket.
+    groups by actual byte size (``size * dtype.itemsize``, like the reference
+    DDP's ``_dist_bucket_tensors(..., 25MB)`` C++ bucketing,
+    `ddp.py:188,238`); an oversized single leaf gets its own bucket.
     """
-    n = len(sizes)
+    n = len(byte_sizes)
     if granularity == "layerwise":
         return [[i] for i in range(n)]
     if granularity == "entiremodel":
         return [list(range(n))] if n else []
     groups, cur, cur_bytes = [], [], 0.0
-    for i, sz in enumerate(sizes):
-        b = 4.0 * sz
+    for i, b in enumerate(byte_sizes):
         if cur and cur_bytes + b > bucket_bytes:
             groups.append(cur)
             cur, cur_bytes = [], 0.0
@@ -167,13 +171,20 @@ def group_concat(leaves, idxs):
     return flats[0] if len(flats) == 1 else jnp.concatenate(flats)
 
 
-def group_split(flat, leaves, idxs, out):
+def group_split(flat, leaves, idxs, out, dtype=None):
     """Slice a group's flat result back into per-leaf shapes, writing into
-    ``out`` at the original leaf positions."""
+    ``out`` at the original leaf positions.
+
+    ``group_concat`` of a mixed-dtype group (bf16 + fp32 leaves) promotes to
+    a common dtype; each output leaf is cast back to the corresponding input
+    leaf's dtype — or to ``dtype`` when given (the EF residual is fp32 by
+    design regardless of gradient precision: sub-epsilon dropped mass must
+    accumulate, not round away)."""
     off = 0
     for i in idxs:
         n = leaves[i].size
-        out[i] = flat[off:off + n].reshape(leaves[i].shape)
+        out[i] = (flat[off:off + n].reshape(leaves[i].shape)
+                  .astype(dtype or leaves[i].dtype))
         off += n
 
 
@@ -251,7 +262,8 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         # static 25 MB buckets.  Per-group psums are left unfused; XLA
         # coalesces/schedules them.
         groups = make_leaf_groups(
-            [g.size for g in leaves], cfg.granularity, cfg.bucket_mb * BUCKET_MB)
+            [g.size * g.dtype.itemsize for g in leaves],
+            cfg.granularity, cfg.bucket_mb * BUCKET_MB)
         out_leaves = [None] * len(leaves)
         new_ef_leaves = [None] * len(leaves)
         sent_total = jnp.asarray(0.0, jnp.float32)
@@ -264,7 +276,8 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             reduced = jax.lax.psum(comp_flat, axis_name) / world
             group_split(reduced, leaves, idxs, out_leaves)
             if use_ef:
-                group_split(acc - comp_flat, leaves, idxs, new_ef_leaves)
+                group_split(acc - comp_flat, leaves, idxs, new_ef_leaves,
+                            dtype=jnp.float32)
             group_sent = sent_count(comp_flat)
             sent_total = sent_total + group_sent
             bits_total = bits_total + sent_bits(comp_flat, group_sent)

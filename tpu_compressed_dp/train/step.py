@@ -67,6 +67,7 @@ def make_train_step(
     mesh: Mesh,
     *,
     grad_scale: float = 1.0,
+    clip_norm: float = 0.0,
     axis_name: str = "data",
     donate: bool = True,
 ):
@@ -74,6 +75,16 @@ def make_train_step(
 
     ``batch`` is ``{'input': [B, ...], 'target': [B]}`` with ``B`` divisible by
     the mesh's data-axis size; metrics are global (psum-reduced) scalars.
+
+    ``clip_norm`` (mean-loss units; 0 = off) clips each worker's local
+    gradient by L2 norm *before* error-feedback accumulation — the DGC-style
+    stabiliser for sparsified training with momentum.  Root-cause analysis
+    (`tools/ef_bisect.py`, `benchmarks/ef_momentum_bisect_r2.txt`): EF defers
+    ~1/k steps of gradient mass per coordinate, and that delay times the
+    momentum gain 1/(1-mu) diverges under the dawn protocol's peak lr — for
+    the reference's own update rule too (torch repro of
+    `sparsified_ddp.py:408-413` + momentum SGD NaNs identically).  Clipping
+    bounds the re-injected residual and restores stable training.
     """
     grad_sync = make_grad_sync(comp_cfg, axis_name)
 
@@ -96,6 +107,13 @@ def make_train_step(
         (loss, (new_bs, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying_params)
 
         scaled = jax.tree.map(lambda g: g.astype(jnp.float32) * grad_scale, grads)
+        if clip_norm > 0.0:
+            # local-gradient clip at mean-loss scale: ||scaled|| / grad_scale
+            # <= clip_norm after this (threshold stays protocol-invariant
+            # under the summed-loss grad_scale pairing)
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(scaled)))
+            factor = jnp.minimum(1.0, clip_norm * grad_scale / jnp.maximum(gnorm, 1e-20))
+            scaled = jax.tree.map(lambda g: g * factor, scaled)
         # EF residual is per-worker state (the reference's per-rank epsilon,
         # sparsified_ddp.py:222): stored with a leading device axis, sharded
         # over the mesh; squeeze the local slice here.
