@@ -39,6 +39,38 @@ class TestTopkThreshold:
         np.testing.assert_array_equal(np.asarray(mag >= t), np.asarray(mag >= exact))
         assert int(jnp.sum(mag >= t)) == keep
 
+    @pytest.mark.parametrize("keep_frac", [0.001, 0.01, 0.1])
+    def test_sampled_init_large_n(self, keep_frac):
+        # n >= 1<<18 engages the sampled-init fast path (slab subsample +
+        # validity round + 3 narrow rounds); the count >= keep guarantee and
+        # tie-level surplus must hold there too
+        n = 1 << 18
+        keep = max(1, int(n * keep_frac))
+        mag = jnp.abs(jax.random.normal(jax.random.key(7), (n,)))
+        t = kernels._topk_threshold_pallas(mag, keep, interpret=True)
+        cnt = int(jnp.sum(mag >= t))
+        assert cnt >= keep
+        assert cnt <= keep + 64  # surplus at final-bin tie resolution only
+
+    def test_sampled_init_fallback_on_adversarial_layout(self):
+        # the slab sample reads the first 128 lanes of each C-block (C=1024
+        # for this n/keep); hide MORE than `keep` spikes in the unsampled
+        # lanes so the sampled bracket is provably invalid (count(>= t_hi)
+        # >= keep) and the exact full-range fallback must deliver the
+        # guarantee anyway
+        n = 1 << 19
+        keep = 1 << 17
+        base = jnp.abs(jax.random.normal(jax.random.key(8), (n,))) * 1e-3
+        lanes = jnp.arange(n) % 1024
+        spike = lanes >= 128  # every lane the slab sample never reads
+        vals = 100.0 + (jnp.arange(n) % 977).astype(jnp.float32) / 977.0
+        mag = jnp.where(spike, vals, base)
+        t = kernels._topk_threshold_pallas(mag, keep, interpret=True)
+        cnt = int(jnp.sum(mag >= t))
+        assert cnt >= keep
+        assert cnt <= int(keep * 1.02)  # fallback resolution, heavy ties
+        assert float(t) > 1.0  # found the spikes, not the base noise
+
     def test_ties_all_kept(self):
         mag = jnp.ones((4096,))
         t = kernels._topk_threshold_pallas(mag, 100, interpret=True)

@@ -1,0 +1,120 @@
+"""Method x ratio convergence grid on the non-saturating synthetic benchmark.
+
+The accuracy half of the reference's Fig. 3/4 protocol (`CIFAR10/dawn.py`
+sweeps: 24 epochs, 40 for Randomk/Thresholdv, bs 512, peak lr 0.4 at ep 5)
+run end-to-end through the dawn harness on ``--synthetic_hard`` data, where
+dense tops out ~0.96 test accuracy and weaker optimisation shows as a lower
+final score — unlike round 1's saturating blobs (VERDICT r1 #2).
+
+Writes one TSV row per grid point: final train/test accuracy + loss, epoch
+count, comm fractions.  Runs serially on whatever backend is live (the real
+chip under the driver; keep the host otherwise idle for honest wall times).
+
+Usage:
+    python tools/convergence_sweep.py --out benchmarks/convergence_r2.tsv
+    python tools/convergence_sweep.py --quick   # 3-epoch smoke of the grid
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+GRID = [
+    # label, harness args (beyond the common protocol)
+    ("dense", []),
+    ("topk-lw-0.1%", ["--compress", "layerwise", "--method", "topk",
+                      "--ratio", "0.001", "--error_feedback"]),
+    ("topk-lw-1%", ["--compress", "layerwise", "--method", "topk",
+                    "--ratio", "0.01", "--error_feedback"]),
+    ("topk-lw-10%", ["--compress", "layerwise", "--method", "topk",
+                     "--ratio", "0.1", "--error_feedback"]),
+    ("topk-em-1%", ["--compress", "entiremodel", "--method", "topk",
+                    "--ratio", "0.01", "--error_feedback"]),
+    ("topk-em-1%-wire", ["--compress", "entiremodel", "--method", "topk",
+                         "--ratio", "0.01", "--error_feedback",
+                         "--mode", "wire"]),
+    # the r1 diverger, now stabilised by --clip_norm (40-epoch rule)
+    ("randomk-em-1%-wire-EF", ["--compress", "entiremodel", "--method",
+                               "randomk", "--ratio", "0.01",
+                               "--error_feedback", "--mode", "wire",
+                               "--clip_norm", "1.0"]),
+    ("randomk-em-1%-mom0", ["--compress", "entiremodel", "--method",
+                            "randomk", "--ratio", "0.01", "--error_feedback",
+                            "--momentum", "0.0"]),
+    ("randomk-em-10%", ["--compress", "entiremodel", "--method", "randomk",
+                        "--ratio", "0.1", "--error_feedback",
+                        "--clip_norm", "1.0"]),
+    ("thresholdv-lw", ["--compress", "layerwise", "--method", "thresholdv",
+                       "--threshold", "0.001"]),
+    ("adaptive-lw", ["--compress", "layerwise", "--method",
+                     "adaptive_threshold"]),
+    ("qsgd-lw-8bit", ["--compress", "layerwise", "--method", "qsgd",
+                      "--qstates", "255"]),
+    ("terngrad-em", ["--compress", "entiremodel", "--method", "terngrad"]),
+    ("blocktopk-em-1%-wire", ["--compress", "entiremodel", "--method",
+                              "blocktopk", "--ratio", "0.01",
+                              "--error_feedback", "--mode", "wire"]),
+]
+
+COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",
+        "train_loss", "test_loss", "sent_frac", "wire_frac", "total_s"]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="benchmarks/convergence_r2.tsv")
+    ap.add_argument("--quick", action="store_true", help="3-epoch smoke")
+    ap.add_argument("--synthetic_n", type=int, default=16384)
+    ap.add_argument("--only", type=str, default=None,
+                    help="comma list of labels to run")
+    args = ap.parse_args(argv)
+
+    from tpu_compressed_dp.harness import dawn
+
+    only = set(args.only.split(",")) if args.only else None
+    rows = []
+    for label, extra in GRID:
+        if only and label not in only:
+            continue
+        argv_run = ["--synthetic_hard", "--synthetic_n", str(args.synthetic_n),
+                    "--momentum", "0.9", "--log_dir", ""] + extra
+        if args.quick:
+            argv_run += ["--epochs", "3"]
+        print(f"### {label}", flush=True)
+        t0 = time.time()
+        s = dawn.main(argv_run)
+        row = {
+            "label": label,
+            "method": next((extra[i + 1] for i, a in enumerate(extra)
+                            if a == "--method"), "none"),
+            "ratio": next((extra[i + 1] for i, a in enumerate(extra)
+                           if a == "--ratio"), ""),
+            "mode": "wire" if "--mode" in extra else "simulate",
+            "epochs": s["epoch"],
+            "train_acc": round(s["train acc"], 4),
+            "test_acc": round(s["test acc"], 4),
+            "train_loss": round(s["train loss"], 4),
+            "test_loss": round(s["test loss"], 4),
+            "sent_frac": round(s.get("sent frac", 1.0), 5),
+            "wire_frac": round(s.get("wire frac", 1.0), 5),
+            "total_s": round(time.time() - t0, 1),
+        }
+        rows.append(row)
+        print({k: row[k] for k in ("label", "test_acc", "train_acc")}, flush=True)
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write("\t".join(COLS) + "\n")
+        for r in rows:
+            f.write("\t".join(str(r[c]) for c in COLS) + "\n")
+    print(f"wrote {args.out} ({len(rows)} rows)")
+
+
+if __name__ == "__main__":
+    main()

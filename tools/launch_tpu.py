@@ -48,10 +48,16 @@ def run_local(args, train_cmd: list) -> int:
     procs = []
     for rank in range(args.local_procs):
         env = dict(os.environ)
+        # replace (not append) any inherited device-count flag: duplicated
+        # XLA flags are an error, and the parent may be a test process that
+        # already forced its own count.  (Inline rather than
+        # mesh.force_host_devices: the launcher must not import jax.)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={args.devices_per_proc}")
         env.update({
             "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
-                          f" --xla_force_host_platform_device_count={args.devices_per_proc}").strip(),
+            "XLA_FLAGS": " ".join(flags),
             "TPU_CDP_COORDINATOR": f"127.0.0.1:{port}",
             "TPU_CDP_NUM_PROCESSES": str(args.local_procs),
             "TPU_CDP_PROCESS_ID": str(rank),
@@ -62,10 +68,10 @@ def run_local(args, train_cmd: list) -> int:
             "--process_id", str(rank),
         ]
         procs.append(subprocess.Popen(cmd, env=env))
-    rc = 0
-    for p in procs:
-        rc = rc or p.wait()
-    return rc
+    # wait on EVERY rank (short-circuiting after the first failure would
+    # orphan the rest mid-rendezvous, holding the coordinator port)
+    rcs = [p.wait() for p in procs]
+    return next((rc for rc in rcs if rc), 0)
 
 
 def main(argv=None) -> int:

@@ -80,6 +80,7 @@ def run_point(
     steps: int = 30,
     warmup: int = 3,
     devices: Optional[int] = None,
+    project_devices: int = 32,
 ) -> Dict[str, float]:
     """Measure one grid point; returns a flat record (also JSON-serialisable)."""
     mesh = make_data_mesh(devices)
@@ -167,6 +168,25 @@ def run_point(
                 ring * dense_mb / 1e3 * (steps / dt), 3),
             "num_collectives": float(metrics["comm/num_collectives"]),
         })
+        # Analytic multi-chip projection (VERDICT r1 weak #6): single-chip
+        # sweeps measure step rate but no real collective traffic (ring
+        # factor 0 at W=1), leaving the headline "allreduce GB/s vs k"
+        # metric empty.  Project a W-chip ring all-reduce — each chip's
+        # links carry 2(W-1)/W x payload per step — at the MEASURED step
+        # rate: the per-chip link-bandwidth demand for compute-bound
+        # scaling, i.e. what the fabric must sustain for compression to
+        # keep hiding behind compute (ceteris paribus on step time, which
+        # single-chip measurement cannot see collectives lengthen).
+        w = int(project_devices)
+        if w > 1:
+            ring_w = 2 * (w - 1) / w
+            record.update({
+                "projected_devices": float(w),
+                "projected_allreduce_gbps_per_chip": round(
+                    ring_w * payload_mb / 1e3 * (steps / dt), 6),
+                "projected_dense_allreduce_gbps_per_chip": round(
+                    ring_w * dense_mb / 1e3 * (steps / dt), 6),
+            })
     return record
 
 
@@ -183,7 +203,8 @@ def run_sweep(args) -> List[Dict[str, float]]:
     common = dict(
         model=args.model, batch_size=args.batch_size, image_size=args.image_size,
         num_classes=args.num_classes, steps=args.steps, warmup=args.warmup,
-        devices=args.devices, mode=args.mode, qstates=args.qstates,
+        devices=args.devices, project_devices=args.project_devices,
+        mode=args.mode, qstates=args.qstates,
         block_size=args.block_size,
         bucket_mb=args.bucket_mb,
         error_feedback=args.error_feedback,
@@ -231,6 +252,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--project_devices", type=int, default=32,
+                   help="W for the analytic W-chip ring allreduce GB/s "
+                        "projection columns (0 disables)")
     p.add_argument("--tsv", type=str, default=None)
     return p
 

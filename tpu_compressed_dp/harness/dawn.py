@@ -112,6 +112,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--peak_lr", type=float, default=0.4)
     p.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     p.add_argument("--synthetic", action="store_true", help="synthetic data smoke run")
+    p.add_argument("--synthetic_hard", action="store_true",
+                   help="non-saturating synthetic benchmark (dense ~0.9 test "
+                        "acc under the 24-epoch protocol) for method x k "
+                        "convergence sweeps")
     p.add_argument("--synthetic_n", type=int, default=2048, help="synthetic train-set size")
     p.add_argument("--channels_scale", type=float, default=1.0,
                    help="width multiplier for the graph-family nets")
@@ -132,6 +136,49 @@ def default_epochs(method: str) -> int:
     return 40 if method.lower() in ("randomk", "thresholdv") else 24
 
 
+class ShardedBatches:
+    """Per-process view of a deterministic global batch stream.
+
+    The multi-host analog of ``DistributedSampler`` (`dataloader.py:33`):
+    every process iterates the SAME global batches (identical seed -> identical
+    shuffle + augmentation draws), slices its rank's contiguous shard, and
+    assembles the global device array whose shards live on local devices
+    (``make_global_batch``).  Identity pass-through single-process.  Eval
+    batches are padded to the static batch size first so every rank's shard
+    keeps one shape (`pad_batch` semantics).
+    """
+
+    def __init__(self, inner, mesh, pad_to: Optional[int] = None,
+                 already_local: bool = False):
+        self.inner = inner
+        self.mesh = mesh
+        self.pad_to = pad_to
+        self.already_local = already_local  # inner yields rank-local slices
+
+    def __len__(self):
+        return len(self.inner)
+
+    def __iter__(self):
+        from tpu_compressed_dp.harness.loop import pad_batch
+        from tpu_compressed_dp.parallel.mesh import make_global_batch
+
+        rank, procs = jax.process_index(), jax.process_count()
+        for b in self.inner:
+            if self.pad_to is not None:
+                b = pad_batch(b, self.pad_to)
+            if procs == 1:
+                yield b
+                continue
+            if self.already_local:
+                local = {k: np.asarray(v) for k, v in b.items()}
+            else:
+                n = len(b["target"])
+                per = n // procs
+                local = {k: np.asarray(v)[rank * per:(rank + 1) * per]
+                         for k, v in b.items()}
+            yield make_global_batch(local, self.mesh)
+
+
 def run(args) -> dict:
     # Pure CLI-flag consistency first, before any I/O or device work.
     if args.method.lower() != "none" and args.compress == "none":
@@ -140,12 +187,6 @@ def run(args) -> dict:
             "(the reference silently trained dense here; we refuse instead)"
         )
     distributed_init(args.coordinator, args.num_processes, args.process_id)
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "multi-process CIFAR harness needs per-process batch sharding "
-            "(jax.make_array_from_process_local_data); single-process "
-            "multi-chip meshes are fully supported"
-        )
     mesh = make_data_mesh(args.devices)
     ndev = mesh.shape["data"]
     epochs = args.epochs if args.epochs is not None else default_epochs(args.method)
@@ -156,20 +197,34 @@ def run(args) -> dict:
     print(f"mesh: {ndev} devices; network={args.network} compress={args.compress} "
           f"method={args.method} epochs={epochs}")
 
-    dataset = (
-        data.synthetic_cifar10(n_train=args.synthetic_n, n_test=max(args.synthetic_n // 4, bs))
-        if args.synthetic
-        else data.load_cifar10(args.data_dir)
-    )
+    if args.synthetic_hard:
+        dataset = data.synthetic_cifar10_hard(
+            n_train=args.synthetic_n, n_test=max(args.synthetic_n // 4, bs))
+    elif args.synthetic:
+        dataset = data.synthetic_cifar10(
+            n_train=args.synthetic_n, n_test=max(args.synthetic_n // 4, bs))
+    else:
+        dataset = data.load_cifar10(args.data_dir)
 
     # batches stay uint8 end-to-end; the compiled step normalises on device
     # (1 byte/pixel over the host->device wire instead of 4)
     train_x = data.pad(dataset["train"]["data"])
     test_x = dataset["test"]["data"]
-    train_batches = data.Batches(train_x, dataset["train"]["labels"], bs,
-                                 shuffle=True, augment=True, drop_last=True, seed=args.seed)
+    procs = jax.process_count()
+    train_batches = data.Batches(
+        train_x, dataset["train"]["labels"], bs, shuffle=True, augment=True,
+        drop_last=True, seed=args.seed,
+        shard=(jax.process_index(), procs) if procs > 1 else None)
     test_batches = data.Batches(test_x, dataset["test"]["labels"], bs,
                                 shuffle=False, augment=False, drop_last=False)
+    if procs > 1:
+        # multi-process: every rank feeds its shard of the global batch
+        # (bs % ndev == 0 was checked above; ndev counts global devices and
+        # the process count divides it, so per-rank shards are equal-sized).
+        # Train batches come rank-local from the sharded iterator (identical
+        # RNG stream on all ranks, pixel work only for the local rows).
+        train_batches = ShardedBatches(train_batches, mesh, already_local=True)
+        test_batches = ShardedBatches(test_batches, mesh, pad_to=bs)
 
     module = MODELS[args.network](args.channels_scale)
     params, stats = init_model(module, jax.random.key(args.seed),
@@ -214,6 +269,10 @@ def run(args) -> dict:
                                  clip_norm=args.clip_norm)
     eval_step = make_eval_step(apply_fn, mesh)
 
+    # epoch summaries print master-only, like the reference's rank-0-gated
+    # loggers (`logger.py:74-121`); metrics are globally reduced so every
+    # rank computes identical numbers anyway
+    rank0 = jax.process_index() == 0
     table, tsv = TableLogger(), TSVLogger()
     # No explicit device sync needed: run_train_epoch keeps metrics on device
     # during the epoch (async dispatch overlaps host batch prep with device
@@ -223,7 +282,8 @@ def run(args) -> dict:
     from tpu_compressed_dp.utils.loggers import TensorboardLogger
 
     tb = TensorboardLogger(
-        os.path.join(args.log_dir, "tb") if args.log_dir and args.tensorboard else None
+        os.path.join(args.log_dir, "tb")
+        if args.log_dir and args.tensorboard and rank0 else None
     )
     summary = {}
     for epoch in range(epochs):
@@ -242,13 +302,14 @@ def run(args) -> dict:
             **{k: (float(v) if isinstance(v, (int, float, np.floating)) else v)
                for k, v in epoch_stats.items()},
         }
-        table.append(summary)
-        tsv.append(summary)
-        tb.update_examples_count(len(train_batches) * bs)
-        tb.log_metrics({f"losses/{k}": v for k, v in summary.items()
-                        if k in ("train loss", "test loss", "train acc", "test acc")})
-        tb.log_scalar("times/epoch_seconds", summary["train time"])
-    if args.log_dir:
+        if rank0:
+            table.append(summary)
+            tsv.append(summary)
+            tb.update_examples_count(len(train_batches) * bs)
+            tb.log_metrics({f"losses/{k}": v for k, v in summary.items()
+                            if k in ("train loss", "test loss", "train acc", "test acc")})
+            tb.log_scalar("times/epoch_seconds", summary["train time"])
+    if args.log_dir and rank0:
         tsv.save(args.log_dir)
     tb.close()
     return summary

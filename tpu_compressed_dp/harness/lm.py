@@ -182,12 +182,15 @@ def run(args) -> Dict[str, float]:
         )
         train_step = make_pp_train_step(cfg, opt, comp, mesh,
                                         microbatches=args.microbatches)
-        if args.resume or args.checkpoint_dir:
-            raise NotImplementedError(
-                "checkpointing the pipelined step: restore re-placement for "
-                "the (data, pipe) mesh is not wired yet"
-            )
-        ckpt = None
+        ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+        if args.resume:
+            from tpu_compressed_dp.train.pp_step import place_pp_state
+
+            restore = Checkpointer(args.resume)
+            state, meta = restore.restore(state)
+            restore.close()
+            state = place_pp_state(state, cfg, comp, mesh)
+            print(f"resumed step {int(state.step)}")
     else:
         state = TrainState.create(
             params, {}, opt.init(params), init_lm_ef_state(cfg, params, comp, mesh),
@@ -220,8 +223,12 @@ def run(args) -> Dict[str, float]:
         batch = ds.batch(step_i)
         state, metrics = train_step(
             state, {k: jnp.asarray(v) for k, v in batch.items()})
-        if step_i == start:
-            # steady-state tokens/sec: exclude the first step's compile
+        if step_i <= start + 1:
+            # steady-state tokens/sec: the jitted step compiles TWICE (the
+            # donated-buffer layouts change the arg signature on call 2), so
+            # barrier-and-reset after each of the first two steps — one
+            # excluded step would leak the second compile (18s+ at 125M
+            # params) into the timed window
             jax.device_get(metrics)
             t0 = time.time()
             timed_from = step_i + 1

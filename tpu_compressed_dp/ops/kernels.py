@@ -142,8 +142,12 @@ def _count_ge_kernel(lo_ref, hi_ref, x_ref, counts_ref):
         edge = lo + width * b
         counts.append(
             jnp.sum(jnp.logical_and(x >= edge, valid).astype(jnp.float32)))
+    # lane _HIST_BINS carries count(x >= hi): lets a sampled-init round
+    # validate its candidate range in the same pass (see
+    # _topk_threshold_sampled)
+    counts.append(jnp.sum((x >= hi).astype(jnp.float32)))
     # full 128-lane row write (lane-partial stores lower poorly on TPU)
-    counts += [jnp.float32(0.0)] * (_LANES - _HIST_BINS)
+    counts += [jnp.float32(0.0)] * (_LANES - _HIST_BINS - 1)
     counts_ref[0, :] += jnp.stack(counts)
 
 
@@ -154,7 +158,8 @@ def _vma(x: Array):
 
 
 def _topk_threshold_pallas(
-    mag: Array, keep: int, *, rounds: int = 7, interpret: bool = False
+    mag: Array, keep: int, *, rounds: int = 7, interpret: bool = False,
+    sample_init: bool = True,
 ) -> Array:
     n = mag.shape[0]
     x2d, num_chunks = _pad_chunks(mag.astype(jnp.float32), fill=-1.0,
@@ -174,16 +179,8 @@ def _topk_threshold_pallas(
     )
 
     keep_f = jnp.float32(min(keep, n))
-    # max|g| strictly below hi so the top element always lands in a bin
-    hi0 = jnp.max(mag) * 1.0000002 + 1e-30
 
-    def round_body(_, carry):
-        lo, hi, above = carry
-        counts = count_ge(
-            lo.reshape(1, 1).astype(jnp.float32),
-            hi.reshape(1, 1).astype(jnp.float32),
-            x2d,
-        )[0][:_HIST_BINS]
+    def narrow(lo, hi, above, counts):
         total_ge = above + counts  # monotone nonincreasing over bins
         b = jnp.sum((total_ge >= keep_f).astype(jnp.int32)) - 1
         b = jnp.clip(b, 0, _HIST_BINS - 1)
@@ -196,15 +193,76 @@ def _topk_threshold_pallas(
         )
         return new_lo, new_hi, new_above
 
-    # the carry becomes device-varying after round 1 (counts derive from the
-    # varying magnitudes) — pcast the replicated init so loop types match
-    vma = tuple(_vma(mag))
-    init = (jnp.float32(0.0), hi0.astype(jnp.float32), jnp.float32(0.0))
-    if vma:
-        init = tuple(
-            jax.lax.pcast(v, vma, to="varying") if not _vma(v) else v for v in init
+    def round_body(_, carry):
+        lo, hi, above = carry
+        counts = count_ge(
+            lo.reshape(1, 1).astype(jnp.float32),
+            hi.reshape(1, 1).astype(jnp.float32),
+            x2d,
+        )[0][:_HIST_BINS]
+        return narrow(lo, hi, above, counts)
+
+    def pcast(vals):
+        # carries become device-varying after a count round (counts derive
+        # from the varying magnitudes) — pcast replicated values so loop /
+        # cond branch types match
+        vma = tuple(_vma(mag))
+        if not vma:
+            return vals
+        return tuple(
+            jax.lax.pcast(v, vma, to="varying") if not _vma(v) else v for v in vals
         )
-    lo, _, _ = jax.lax.fori_loop(0, rounds, round_body, init)
+
+    # max|g| strictly below hi so the top element always lands in a bin
+    full_init = pcast(
+        (jnp.float32(0.0), (jnp.max(mag) * 1.0000002 + 1e-30).astype(jnp.float32),
+         jnp.float32(0.0)))
+
+    if not sample_init or keep < 1 or n < (1 << 18):
+        lo, _, _ = jax.lax.fori_loop(0, rounds, round_body, full_init)
+        return lo
+
+    # Sampled init (one subsample brackets the k-th magnitude, then a
+    # validity count round + 3 narrow rounds replace the 7 full-range rounds;
+    # an exact full-range fallback runs when the sample misjudged — the
+    # count(mag >= t) >= keep guarantee is unconditional):
+    #   * sample size targets ~4096 expected survivors so the top_k on the
+    #     sample stays cheap at every keep;
+    #   * rank margin 4*sqrt(r)+8 makes a sample miss (true k-th magnitude
+    #     outside [t_lo, t_hi)) a multi-sigma event;
+    #   * the sample is the first 128 lanes of every C-element block — 512 B
+    #     contiguous reads spread across the whole tensor (a fine-strided
+    #     slice costs ~a full pass in gathers; slab reads are ~free).
+    m_target = int(min(max(4096 * n / keep, 1 << 16), 1 << 21))
+    C = 128
+    while C < (1 << 17) and n * 128 // (C * 2) >= m_target and C * 2 <= n:
+        C *= 2
+    nb = n // C
+    m = nb * 128
+    sample = jax.lax.slice(
+        mag[: nb * C].reshape(nb, C).astype(jnp.float32), (0, 0), (nb, 128)
+    ).reshape(-1)
+    r = keep * m / n
+    delta = 4.0 * float(r) ** 0.5 + 8.0
+    hi_rank = int(min(m - 1, r + delta))
+    lo_rank = int(max(0, r - delta))
+    sv = jax.lax.top_k(sample, hi_rank + 1)[0]
+    t_lo = sv[hi_rank]
+    t_hi = jnp.maximum(sv[lo_rank], t_lo) * 1.0000002 + 1e-30
+
+    row = count_ge(t_lo.reshape(1, 1), t_hi.reshape(1, 1), x2d)[0]
+    counts0 = row[:_HIST_BINS]
+    above0 = row[_HIST_BINS]          # count(mag >= t_hi)
+    ge_lo = above0 + counts0[0]       # count(mag >= t_lo)
+    ok = jnp.logical_and(above0 < keep_f, ge_lo >= keep_f)
+
+    narrowed = narrow(t_lo, t_hi, above0, counts0)
+    lo = jax.lax.cond(
+        ok,
+        lambda c: jax.lax.fori_loop(0, 3, round_body, c)[0],
+        lambda c: jax.lax.fori_loop(0, rounds, round_body, full_init)[0],
+        narrowed,
+    )
     return lo
 
 

@@ -45,6 +45,21 @@ def distributed_init(
     (``--master_address``, ``--world_size``, ``--rank``, `dawn.py:11-13`).
     No-ops when running single-process.
     """
+    # Eagerly-registered PJRT plugins force their platform into the config at
+    # interpreter start and ignore JAX_PLATFORMS set later by a parent
+    # process.  For processes WE spawned (the local launcher's rendezvous
+    # marker is present), re-assert the launcher's platform choice through
+    # the config — effective until first backend use.  Never touch the
+    # platform otherwise: the ambient environment may carry the plugin's own
+    # JAX_PLATFORMS, and clobbering an explicit user config with it would
+    # break CPU-forced test processes.
+    if "TPU_CDP_COORDINATOR" in os.environ:
+        want = os.environ.get("JAX_PLATFORMS")
+        if want:
+            try:
+                jax.config.update("jax_platforms", want)
+            except Exception:
+                pass
     if num_processes is not None and num_processes <= 1:
         return
     if coordinator_address is None and num_processes is None and "COORDINATOR_ADDRESS" not in os.environ:
@@ -57,17 +72,24 @@ def distributed_init(
     )
 
 
-def force_host_devices(n: int) -> None:
+def force_host_devices(n: int, env: Optional[dict] = None) -> dict:
     """Emulate an ``n``-chip mesh on CPU (the JAX-native multi-device fake).
 
     Must run before the first JAX backend initialisation.  This is the test
     fixture the reference lacked (SURVEY.md §4): its closest analog was N
-    Gloo processes on one machine.
+    Gloo processes on one machine.  Replaces (never appends alongside) any
+    inherited device-count flag — duplicated XLA flags are an error.
+    Mutates and returns ``env`` (default ``os.environ``) so spawn sites can
+    use it on a copied environment.
     """
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    if env is None:
+        env = os.environ
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
 
 
 def make_data_mesh(num_devices: Optional[int] = None, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:

@@ -96,27 +96,7 @@ def place_lm_state(state: TrainState, cfg: LlamaConfig, comp: CompressionConfig,
     """Shard a (restored) TrainState onto the 3-D mesh per lm_state_specs —
     the LM analog of ``TrainState.with_mesh_sharding`` (checkpoint restore
     lands everything on one device)."""
-    from jax.sharding import NamedSharding
-
-    specs = lm_state_specs(cfg, comp)
-    def place(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    placed = {}
-    for f in dataclasses.fields(state):
-        val, spec = getattr(state, f.name), getattr(specs, f.name)
-        if f.name == "ef" and state.ef == ():
-            placed[f.name] = ()
-        elif isinstance(spec, P):
-            placed[f.name] = jax.tree.map(lambda v: place(v, spec), val)
-        else:
-            spec_leaves = jax.tree.leaves(spec, is_leaf=lambda x: isinstance(x, P))
-            val_leaves = jax.tree.leaves(val)
-            placed[f.name] = jax.tree.unflatten(
-                jax.tree.structure(val),
-                [place(v, s) for v, s in zip(val_leaves, spec_leaves)],
-            )
-    return TrainState(**placed)
+    return state.place_with_specs(lm_state_specs(cfg, comp), mesh)
 
 
 def make_lm_train_step(

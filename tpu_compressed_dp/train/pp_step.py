@@ -59,7 +59,17 @@ from tpu_compressed_dp.train.step import optimizer_lr
 Array = jax.Array
 
 __all__ = ["make_pp_mesh", "stack_layer_params", "pp_state_specs",
-           "make_pp_train_step", "init_pp_ef_state"]
+           "make_pp_train_step", "init_pp_ef_state", "place_pp_state"]
+
+
+def place_pp_state(state: TrainState, cfg: "LlamaConfig",
+                   comp: CompressionConfig, mesh: Mesh) -> TrainState:
+    """Re-place a (restored) stacked-layer TrainState onto the (data, pipe)
+    mesh per ``pp_state_specs`` — checkpoint restore lands every array on one
+    device, and the pipelined step needs layer stacks sharded over ``pipe``
+    and EF residuals over ``data`` (`train_imagenet_nv.py:193-198` is the
+    reference's resume)."""
+    return state.place_with_specs(pp_state_specs(cfg, comp), mesh)
 
 
 def make_pp_mesh(data: int, pipe: int) -> Mesh:

@@ -53,3 +53,29 @@ class TrainState:
         }
         ef = self.ef if self.ef == () else jax.device_put(self.ef, dat)
         return dataclasses.replace(self, ef=ef, **placed)
+
+    def place_with_specs(self, specs: "TrainState", mesh: Mesh) -> "TrainState":
+        """Place every field per a specs-TrainState (fields are PartitionSpecs
+        or pytrees of them, e.g. ``lm_state_specs`` / ``pp_state_specs``).
+        Needed after a checkpoint restore (which lands arrays on one device)
+        before a shard_map'd step will accept the state."""
+
+        def place(x, spec):
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        placed = {}
+        for f in dataclasses.fields(self):
+            val, spec = getattr(self, f.name), getattr(specs, f.name)
+            if f.name == "ef" and self.ef == ():
+                placed[f.name] = ()
+            elif isinstance(spec, P):
+                placed[f.name] = jax.tree.map(lambda v: place(v, spec), val)
+            else:
+                spec_leaves = jax.tree.leaves(
+                    spec, is_leaf=lambda x: isinstance(x, P))
+                val_leaves = jax.tree.leaves(val)
+                placed[f.name] = jax.tree.unflatten(
+                    jax.tree.structure(val),
+                    [place(v, s) for v, s in zip(val_leaves, spec_leaves)],
+                )
+        return TrainState(**placed)
