@@ -55,6 +55,28 @@ def test_val_loader_equal_batch_count_across_processes():
     assert total == 50  # every image seen exactly once
 
 
+def test_rect_val_falls_back_to_square_multihost(monkeypatch):
+    # Rect-val hands each process differently-shaped local batches — fine
+    # under the reference's per-process NCCL (`dataloader.py:133-175`),
+    # incompatible with one global SPMD array.  Pin the documented fallback
+    # (VERDICT r1 weak #8): multi-process phases silently request square val.
+    from tpu_compressed_dp.harness.imagenet import PhaseData
+
+    ds_t = inet.SyntheticImages(64, num_classes=10)
+    ds_v = inet.SyntheticImages(32, num_classes=10)
+    phases = [{"ep": 0, "sz": 32, "bs": 16, "rect_val": True}]
+
+    pd = PhaseData(ds_t, ds_v, phases, workers=1)
+    pd.set_epoch(0)
+    assert pd.val_loader.rect_val  # single-process: rect honoured
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    pd2 = PhaseData(ds_t, ds_v, phases, workers=1)
+    pd2.set_epoch(0)
+    assert not pd2.val_loader.rect_val  # multi-host: square fallback
+
+
 def test_val_loader_rect_shapes_bounded():
     ds = inet.SyntheticImages(64, num_classes=10)
     dl = inet.ValLoader(ds, 8, 32, rect_val=True, ar_buckets=4, workers=2)

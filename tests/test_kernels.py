@@ -39,36 +39,51 @@ class TestTopkThreshold:
         np.testing.assert_array_equal(np.asarray(mag >= t), np.asarray(mag >= exact))
         assert int(jnp.sum(mag >= t)) == keep
 
-    @pytest.mark.parametrize("keep_frac", [0.001, 0.01, 0.1])
+    @pytest.mark.parametrize("keep_frac", [0.01, 0.1])
     def test_sampled_init_large_n(self, keep_frac):
-        # n >= 1<<18 engages the sampled-init fast path (slab subsample +
-        # validity round + 3 narrow rounds); the count >= keep guarantee and
-        # tie-level surplus must hold there too
-        n = 1 << 18
+        # large n + moderate keep engages the sampled-init fast path (slab
+        # subsample -> quantile-edge round -> 3 narrow rounds; the gate
+        # requires the sample to be <= n/16, true here); the count >= keep
+        # guarantee and tie-level surplus must hold there too
+        n = 1 << 22
         keep = max(1, int(n * keep_frac))
         mag = jnp.abs(jax.random.normal(jax.random.key(7), (n,)))
         t = kernels._topk_threshold_pallas(mag, keep, interpret=True)
         cnt = int(jnp.sum(mag >= t))
         assert cnt >= keep
-        assert cnt <= keep + 64  # surplus at final-bin tie resolution only
+        assert cnt <= keep + 256  # surplus at final-bin resolution only
 
-    def test_sampled_init_fallback_on_adversarial_layout(self):
-        # the slab sample reads the first 128 lanes of each C-block (C=1024
+    def test_small_or_dense_keep_uses_exact_full_path(self):
+        # mid-size tensors (sample can't be << data) must take the exact
+        # full-range histogram: tie-exact count
+        n = 1 << 18
+        keep = 262
+        mag = jnp.abs(jax.random.normal(jax.random.key(9), (n,)))
+        t = kernels._topk_threshold_pallas(mag, keep, interpret=True)
+        assert int(jnp.sum(mag >= t)) == keep
+
+    def test_sampled_init_adversarial_layout_keeps_guarantee(self):
+        # the slab sample reads the first 128 lanes of each C-block (C=4096
         # for this n/keep); hide MORE than `keep` spikes in the unsampled
-        # lanes so the sampled bracket is provably invalid (count(>= t_hi)
-        # >= keep) and the exact full-range fallback must deliver the
-        # guarantee anyway
-        n = 1 << 19
-        keep = 1 << 17
+        # lanes so every sample quantile is noise-level and the k-th
+        # magnitude lands in the huge top bin.  The structural guarantee
+        # (count >= keep; refine rounds shrink the surplus) must survive
+        # this worst case — there is deliberately no data-dependent branch
+        # (a cond would run both sides under shard_map).
+        n = 1 << 22
+        keep = int(n * 0.1)
         base = jnp.abs(jax.random.normal(jax.random.key(8), (n,))) * 1e-3
-        lanes = jnp.arange(n) % 1024
+        lanes = jnp.arange(n) % 4096
         spike = lanes >= 128  # every lane the slab sample never reads
         vals = 100.0 + (jnp.arange(n) % 977).astype(jnp.float32) / 977.0
         mag = jnp.where(spike, vals, base)
         t = kernels._topk_threshold_pallas(mag, keep, interpret=True)
         cnt = int(jnp.sum(mag >= t))
         assert cnt >= keep
-        assert cnt <= int(keep * 1.02)  # fallback resolution, heavy ties
+        # degraded-case surplus is bounded by the selected bin's population
+        # after 16^3 refinement (~4% here); EF reabsorbs the boundary
+        # elements the fixed-size pack then drops
+        assert cnt <= int(keep * 1.05)
         assert float(t) > 1.0  # found the spikes, not the base noise
 
     def test_ties_all_kept(self):

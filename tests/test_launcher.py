@@ -48,9 +48,16 @@ class TestLocalMode:
         """2 processes x 2 virtual CPU devices: the dawn harness shards the
         global batch per process (`ShardedBatches`), syncs compressed
         gradients across the 4-device mesh, and both ranks exit 0."""
+        import socket
+
+        # OS-assigned free port: a hardcoded one collides with concurrent
+        # pytest sessions or a leftover child from a timed-out run
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
         out = subprocess.run(
             [sys.executable, LAUNCHER, "--local_procs", "2",
-             "--devices_per_proc", "2", "--port", "29441", "--",
+             "--devices_per_proc", "2", "--port", str(port), "--",
              sys.executable, "-m", "tpu_compressed_dp.harness.dawn",
              "--synthetic", "--synthetic_n", "256", "--epochs", "2",
              "--batch_size", "64", "--channels_scale", "0.125",

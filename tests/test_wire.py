@@ -139,12 +139,63 @@ class TestQuantizerWire:
             run_sync(mesh8, cfg, make_grads())
 
 
-class TestWireRejections:
+class TestThresholdWire:
+    """Fixed-capacity wire Threshold-V / Adaptive-Threshold (6/6 wire
+    matrix): survivors pack into a cap-sized buffer; overflow stays in EF."""
+
     @pytest.mark.parametrize("method", ["thresholdv", "adaptive_threshold"])
-    def test_dynamic_size_methods_rejected(self, mesh8, method):
-        cfg = CompressionConfig(method=method, mode="wire")
-        with pytest.raises(NotImplementedError, match="simulate"):
-            run_sync(mesh8, cfg, make_grads())
+    def test_matches_simulate_when_capacity_suffices(self, mesh8, method):
+        grads = make_grads()
+        kw = {"threshold": 0.8} if method == "thresholdv" else {}
+        sim = CompressionConfig(method=method, granularity="layerwise", **kw)
+        wire = CompressionConfig(method=method, granularity="layerwise",
+                                 mode="wire", wire_cap_ratio=1.0, **kw)
+        out_s, _, stats_s = run_sync(mesh8, sim, grads)
+        out_w, _, stats_w = run_sync(mesh8, wire, grads)
+        for k in out_s:
+            np.testing.assert_allclose(np.asarray(out_s[k]), np.asarray(out_w[k]),
+                                       rtol=1e-5, atol=1e-6)
+        assert float(stats_w["threshold_overflow"]) == 0.0
+        # both modes count the coordinates that actually survived
+        assert float(stats_w["sent_elems"]) == pytest.approx(
+            float(stats_s["sent_elems"]))
+
+    def test_overflow_goes_to_ef(self, mesh8):
+        # capacity 25% but ~50% of coordinates survive V: the clipped
+        # survivors must land in the residual, and sent + residual must
+        # reassemble the accumulated gradient exactly
+        grads = make_grads(n=256)
+        cfg = CompressionConfig(method="thresholdv", threshold=0.5,
+                                granularity="entiremodel", mode="wire",
+                                wire_cap_ratio=0.25, error_feedback=True)
+        out, new_ef, stats = run_sync(mesh8, cfg, grads)
+        assert float(stats["threshold_overflow"]) > 0.0
+        # device-0 decomposition: gradient == sent + residual, exactly
+        sent = {k: np.asarray(grads[k])[0] - np.asarray(new_ef[k])
+                for k in grads}
+        sent_flat = np.concatenate([sent[k].ravel() for k in sorted(sent)])
+        nz = sent_flat[sent_flat != 0.0]
+        # every coordinate that travelled exceeded V
+        assert np.all(np.abs(nz) >= 0.5)
+        # the cap-sized buffer filled completely (more survivors than cap)
+        n_total = sum(np.asarray(v)[0].size for v in grads.values())
+        cap = round(0.25 * n_total)
+        assert len(nz) == cap
+
+    def test_cap_billing_is_static(self, mesh8):
+        # transport bills the full cap buffer even when half-empty
+        grads = make_grads(n=256)
+        cfg = CompressionConfig(method="thresholdv", threshold=100.0,
+                                granularity="entiremodel", mode="wire",
+                                wire_cap_ratio=0.25)
+        _, _, stats = run_sync(mesh8, cfg, grads)
+        n_total = 256 + 8
+        cap = round(0.25 * n_total)
+        assert float(stats["sent_bits"]) == cap * 64.0
+        assert float(stats["sent_elems"]) == 0.0  # nothing survived V=100
+
+
+class TestWireRejections:
 
     def test_dense_over_wire_falls_back_to_dense_allreduce(self, mesh8):
         # method=None has no sparse form; its wire format IS the dense psum.

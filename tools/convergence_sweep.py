@@ -39,17 +39,18 @@ GRID = [
     ("topk-em-1%-wire", ["--compress", "entiremodel", "--method", "topk",
                          "--ratio", "0.01", "--error_feedback",
                          "--mode", "wire"]),
-    # the r1 diverger, now stabilised by --clip_norm (40-epoch rule)
+    # the r1 diverger, now stabilised by local + sent clipping (40-epoch rule)
     ("randomk-em-1%-wire-EF", ["--compress", "entiremodel", "--method",
                                "randomk", "--ratio", "0.01",
                                "--error_feedback", "--mode", "wire",
-                               "--clip_norm", "1.0"]),
+                               "--clip_norm", "1.0",
+                               "--clip_sent_norm", "1.0"]),
     ("randomk-em-1%-mom0", ["--compress", "entiremodel", "--method",
                             "randomk", "--ratio", "0.01", "--error_feedback",
                             "--momentum", "0.0"]),
     ("randomk-em-10%", ["--compress", "entiremodel", "--method", "randomk",
                         "--ratio", "0.1", "--error_feedback",
-                        "--clip_norm", "1.0"]),
+                        "--clip_norm", "1.0", "--clip_sent_norm", "1.0"]),
     ("thresholdv-lw", ["--compress", "layerwise", "--method", "thresholdv",
                        "--threshold", "0.001"]),
     ("adaptive-lw", ["--compress", "layerwise", "--method",
@@ -57,6 +58,7 @@ GRID = [
     ("qsgd-lw-8bit", ["--compress", "layerwise", "--method", "qsgd",
                       "--qstates", "255"]),
     ("terngrad-em", ["--compress", "entiremodel", "--method", "terngrad"]),
+    ("terngrad-lw", ["--compress", "layerwise", "--method", "terngrad"]),
     ("blocktopk-em-1%-wire", ["--compress", "entiremodel", "--method",
                               "blocktopk", "--ratio", "0.01",
                               "--error_feedback", "--mode", "wire"]),

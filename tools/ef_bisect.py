@@ -93,7 +93,6 @@ def run_jax(momentum: float, nesterov: bool, ef: bool, ef_style: str,
         logz = jax.nn.log_softmax(logits)
         return -jnp.sum(jnp.take_along_axis(logz, yb[:, None], 1))
 
-    flat_sizes = [int(v.size) for v in jax.tree.leaves(params)]
 
     def compress(flat, key, step):
         n_el = flat.shape[0]
@@ -166,6 +165,13 @@ def run_jax(momentum: float, nesterov: bool, ef: bool, ef_style: str,
                 acc = (resid[name] + gl) if ef else gl
                 sent, mask = compress(acc, jax.random.fold_in(k2, hash(name) % 997), step)
                 r = jnp.where(mask, 0.0, acc) if ef else resid[name]
+                if ef_style == "clip_sent":
+                    # clip the aggregated sparse update itself: bounds the
+                    # ~1/k-step residual spike, which local-gradient clipping
+                    # cannot (the residual accumulates clipped inflow for
+                    # 1/k steps and still releases it at once)
+                    snorm = jnp.linalg.norm(sent) / batch
+                    sent = sent * jnp.minimum(1.0, 1.0 / jnp.maximum(snorm, 1e-12))
                 d = sent + wd * p[name].reshape(-1)
                 buf = momentum * mom[name] + d
                 upd = d + momentum * buf if nesterov else buf
@@ -265,6 +271,9 @@ def main(argv=None):
         ("topk+EF21    mom=.9 nesterov", 0.9, True, True, "ef21", "topk"),
     ]
     clip_cases = [
+        # clip the SENT (aggregated sparse) update instead of the local grad
+        ("randomk+EF mom=.9 nesterov CLIP-SENT=1", 0.9, True, "clip_sent", "randomk", 0.0, False),
+        ("randomk+EF mom=.9 CLIP-SENT + CLIP-local", 0.9, True, "clip_sent", "randomk", 1.0, False),
         # (label, momentum, nesterov, ef_style, method, clip, warmup)
         ("randomk+EF mom=.9 nesterov CLIP=1", 0.9, True, "plain", "randomk", 1.0, False),
         ("randomk+EF mom=.9 nesterov CLIP=1 +WARMUP", 0.9, True, "plain", "randomk", 1.0, True),

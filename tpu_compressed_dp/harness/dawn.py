@@ -105,6 +105,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="local-gradient L2 clip (mean-loss units; 0=off) — the "
                         "DGC-style stabiliser for EF + momentum (see "
                         "tools/ef_bisect.py)")
+    p.add_argument("--clip_sent_norm", type=float, default=0.0,
+                   help="post-aggregation L2 clip of the synced gradient "
+                        "(bounds the EF residual spike; see tools/ef_bisect.py)")
     p.add_argument("--mode", type=str, default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--epochs", type=int, default=None, help="override the 24/40 rule")
@@ -266,7 +269,8 @@ def run(args) -> dict:
         std=np.asarray(data.CIFAR10_STD) * 255.0,
     )
     train_step = make_train_step(apply_fn, opt, comp, mesh, grad_scale=float(bs),
-                                 clip_norm=args.clip_norm)
+                                 clip_norm=args.clip_norm,
+                                 clip_sent_norm=args.clip_sent_norm)
     eval_step = make_eval_step(apply_fn, mesh)
 
     # epoch summaries print master-only, like the reference's rank-0-gated

@@ -142,12 +142,29 @@ def _count_ge_kernel(lo_ref, hi_ref, x_ref, counts_ref):
         edge = lo + width * b
         counts.append(
             jnp.sum(jnp.logical_and(x >= edge, valid).astype(jnp.float32)))
-    # lane _HIST_BINS carries count(x >= hi): lets a sampled-init round
-    # validate its candidate range in the same pass (see
-    # _topk_threshold_sampled)
-    counts.append(jnp.sum((x >= hi).astype(jnp.float32)))
     # full 128-lane row write (lane-partial stores lower poorly on TPU)
-    counts += [jnp.float32(0.0)] * (_LANES - _HIST_BINS - 1)
+    counts += [jnp.float32(0.0)] * (_LANES - _HIST_BINS)
+    counts_ref[0, :] += jnp.stack(counts)
+
+
+def _count_edges_kernel(edges_ref, x_ref, counts_ref):
+    """counts[b] += #{x : edges[b] <= x < edges[b+1]} for an ARBITRARY
+    ascending edge array of _HIST_BINS+1 entries in SMEM — the data-adapted
+    first round of the sampled threshold (equispaced bins can't exploit the
+    sample without a branch; quantile edges can)."""
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    x = x_ref[:]
+    hi = edges_ref[0, _HIST_BINS]
+    valid = x < hi
+    counts = []
+    for b in range(_HIST_BINS):
+        counts.append(jnp.sum(
+            jnp.logical_and(x >= edges_ref[0, b], valid).astype(jnp.float32)))
+    counts += [jnp.float32(0.0)] * (_LANES - _HIST_BINS)
     counts_ref[0, :] += jnp.stack(counts)
 
 
@@ -222,23 +239,35 @@ def _topk_threshold_pallas(
         lo, _, _ = jax.lax.fori_loop(0, rounds, round_body, full_init)
         return lo
 
-    # Sampled init (one subsample brackets the k-th magnitude, then a
-    # validity count round + 3 narrow rounds replace the 7 full-range rounds;
-    # an exact full-range fallback runs when the sample misjudged — the
-    # count(mag >= t) >= keep guarantee is unconditional):
-    #   * sample size targets ~4096 expected survivors so the top_k on the
+    # Sampled init, BRANCHLESS (a lax.cond fallback would run BOTH branches
+    # under shard_map — the predicate is device-varying — costing more than
+    # the full histogram).  Round 1 counts at data-adapted edges: quantiles
+    # of a subsample around the expected k-th rank, bracketed by 0 below and
+    # (global max)*(1+eps) above, so the k-th magnitude ALWAYS falls in some
+    # bin — no validity branch, and when the sample is representative
+    # (always, in practice) the selected bin is already ~delta ranks wide.
+    # Two equispaced rounds then refine by 16^2.
+    #   * sample size targets ~1024 expected survivors so the top_k on the
     #     sample stays cheap at every keep;
-    #   * rank margin 4*sqrt(r)+8 makes a sample miss (true k-th magnitude
-    #     outside [t_lo, t_hi)) a multi-sigma event;
     #   * the sample is the first 128 lanes of every C-element block — 512 B
     #     contiguous reads spread across the whole tensor (a fine-strided
-    #     slice costs ~a full pass in gathers; slab reads are ~free).
-    m_target = int(min(max(4096 * n / keep, 1 << 16), 1 << 21))
+    #     slice costs ~a full pass in gathers; slab reads are ~free);
+    #   * worst case (adversarial layout hiding all mass from the sample)
+    #     degrades RESOLUTION only — the count(mag >= t) >= keep guarantee
+    #     is structural (narrow() keeps the k-th inside [lo, hi)), with
+    #     surplus up to the selected bin's population instead of tie-level.
+    m_target = int(min(max(1024 * n / keep, 1 << 16), 1 << 21))
     C = 128
     while C < (1 << 17) and n * 128 // (C * 2) >= m_target and C * 2 <= n:
         C *= 2
     nb = n // C
     m = nb * 128
+    if m > n // 16:
+        # mid-size tensors where the sample can't be much smaller than the
+        # data: the sample top_k would rival the full histogram — use the
+        # exact full-range rounds instead
+        lo, _, _ = jax.lax.fori_loop(0, rounds, round_body, full_init)
+        return lo
     sample = jax.lax.slice(
         mag[: nb * C].reshape(nb, C).astype(jnp.float32), (0, 0), (nb, 128)
     ).reshape(-1)
@@ -247,22 +276,49 @@ def _topk_threshold_pallas(
     hi_rank = int(min(m - 1, r + delta))
     lo_rank = int(max(0, r - delta))
     sv = jax.lax.top_k(sample, hi_rank + 1)[0]
-    t_lo = sv[hi_rank]
-    t_hi = jnp.maximum(sv[lo_rank], t_lo) * 1.0000002 + 1e-30
-
-    row = count_ge(t_lo.reshape(1, 1), t_hi.reshape(1, 1), x2d)[0]
-    counts0 = row[:_HIST_BINS]
-    above0 = row[_HIST_BINS]          # count(mag >= t_hi)
-    ge_lo = above0 + counts0[0]       # count(mag >= t_lo)
-    ok = jnp.logical_and(above0 < keep_f, ge_lo >= keep_f)
-
-    narrowed = narrow(t_lo, t_hi, above0, counts0)
-    lo = jax.lax.cond(
-        ok,
-        lambda c: jax.lax.fori_loop(0, 3, round_body, c)[0],
-        lambda c: jax.lax.fori_loop(0, rounds, round_body, full_init)[0],
-        narrowed,
+    # 15 interior quantile edges spanning [rank r+delta, rank r-delta],
+    # ascending in value (17 edges = 16 bins with the 0 and max*(1+eps)
+    # brackets); duplicate edges (sample ties) just yield empty bins
+    qranks = [int(round(lo_rank + (hi_rank - lo_rank) * i / 14.0))
+              for i in range(15)]
+    interior = [sv[rk] for rk in reversed(qranks)]           # ascending
+    hi0 = full_init[1]                                       # max*(1+eps)
+    edges = jnp.stack(
+        [jnp.float32(0.0) if not _vma(mag)
+         else jax.lax.pcast(jnp.float32(0.0), tuple(_vma(mag)), to="varying")]
+        + [jnp.minimum(e, hi0) for e in interior] + [hi0]
     )
+
+    count_edges = pl.pallas_call(
+        _count_edges_kernel,
+        grid=(num_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, _HIST_BINS + 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((_HIST_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _LANES), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, _LANES), jnp.float32, vma=_vma(mag)),
+        interpret=interpret,
+    )
+    counts = count_edges(edges.reshape(1, -1), x2d)[0][:_HIST_BINS]
+    # bin selection against the edge ARRAY (narrow()'s arithmetic edges
+    # don't apply to the quantile round)
+    total_ge = counts  # counts[b] already counts >= edges[b] (above == 0)
+    b = jnp.clip(jnp.sum((total_ge >= keep_f).astype(jnp.int32)) - 1,
+                 0, _HIST_BINS - 1)
+    new_lo = edges[b]
+    new_hi = edges[b + 1]
+    counts_ext = jnp.concatenate([counts, jnp.zeros((1,), jnp.float32)])
+    new_above = counts_ext[jnp.clip(b + 1, 0, _HIST_BINS)]
+    carry = (new_lo, new_hi, new_above)
+    # 4 equispaced rounds refine the selected bin by 16^4: tie-level surplus
+    # for representative samples, and a few percent even when the whole
+    # top-k mass hides from the sample (the degraded worst case — see
+    # tests/test_kernels.py adversarial-layout case)
+    lo, _, _ = jax.lax.fori_loop(0, 4, round_body, carry)
     return lo
 
 

@@ -94,6 +94,11 @@ class CompressionConfig:
     check_sync: bool = False
     block_size: int = 256  # blocktopk: elements per contiguous block
     bucket_mb: float = 25.0  # bucketed: capacity per bucket (ddp.py:188)
+    # wire thresholdv/adaptive_threshold: transport capacity as a fraction of
+    # elements (survivor counts are data-dependent; the wire buffer is not).
+    # Overflowing survivors stay in the EF residual (or are dropped, EF off);
+    # comm/threshold_overflow reports the clip count.
+    wire_cap_ratio: float = 0.05
 
     def __post_init__(self):
         if self.granularity not in ("layerwise", "entiremodel", "bucketed"):

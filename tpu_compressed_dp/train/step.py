@@ -68,6 +68,7 @@ def make_train_step(
     *,
     grad_scale: float = 1.0,
     clip_norm: float = 0.0,
+    clip_sent_norm: float = 0.0,
     axis_name: str = "data",
     donate: bool = True,
 ):
@@ -85,6 +86,13 @@ def make_train_step(
     the reference's own update rule too (torch repro of
     `sparsified_ddp.py:408-413` + momentum SGD NaNs identically).  Clipping
     bounds the re-injected residual and restores stable training.
+
+    ``clip_sent_norm`` (same units; 0 = off) clips the *synced* gradient
+    after aggregation, which bounds the ~1/k-step residual spike itself —
+    local clipping cannot (the residual accumulates clipped inflow for 1/k
+    steps and still releases it at once).  For Random-K + EF + momentum the
+    bisect shows clip-sent ~20x lower final loss than clip-local alone;
+    combine both for the most robust protocol.
     """
     grad_sync = make_grad_sync(comp_cfg, axis_name)
 
@@ -120,6 +128,11 @@ def make_train_step(
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
         synced, new_ef, comm = grad_sync(scaled, ef_local, comp_key)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
+        if clip_sent_norm > 0.0:
+            snorm = jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(synced)))
+            sfactor = jnp.minimum(
+                1.0, clip_sent_norm * grad_scale / jnp.maximum(snorm, 1e-20))
+            synced = jax.tree.map(lambda g: g * sfactor, synced)
 
         new_step = state.step + 1
         new_params, new_opt = optimizer.apply(state.params, synced, state.opt_state, new_step)
