@@ -12,7 +12,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
 if "xla_backend_optimization_level" not in _flags:
     # tests are compile-time dominated on the CPU backend; O0 keeps XLA
-    # semantics while cutting suite wall time ~2.5x (VERDICT r1 weak #5)
+    # semantics while cutting suite wall time ~2.5x (VERDICT r1 weak #5).
+    # NB the CI host has ONE cpu core (nproc=1): every compile serializes,
+    # xdist can't help, and the persistent compilation cache doesn't engage
+    # on the CPU backend — full-suite wall time is bounded by total compile
+    # work (~15 min here; minutes on a normal multi-core host).
     _flags = (_flags + " --xla_backend_optimization_level=0").strip()
 os.environ["XLA_FLAGS"] = _flags
 

@@ -7,7 +7,7 @@ from tpu_compressed_dp.bench import sweep
 
 def test_run_point_dense(mesh8):
     rec = sweep.run_point(model="resnet9", method=None, batch_size=64,
-                          steps=2, warmup=1, devices=8)
+                          steps=2, warmup=1, devices=8, channels_scale=0.125)
     assert rec["devices"] == 8
     assert rec["images_per_sec"] > 0
     assert rec["sent_frac"] == 1.0 and rec["wire_frac"] == 1.0
@@ -17,7 +17,7 @@ def test_run_point_dense(mesh8):
 def test_run_point_topk_layerwise(mesh8):
     rec = sweep.run_point(model="resnet9", method="topk", ratio=0.01,
                           granularity="layerwise", batch_size=64,
-                          steps=2, warmup=1, devices=8)
+                          steps=2, warmup=1, devices=8, channels_scale=0.125)
     assert 0.005 < rec["sent_frac"] < 0.05  # ~1% + tiny-tensor rounding
     assert rec["payload_mb_per_step"] < rec["dense_mb_per_step"] * 0.05
     assert rec["num_collectives"] > 1
@@ -32,7 +32,8 @@ def test_run_point_projected_comm_columns(mesh8):
     W-chip ring projection so 'allreduce GB/s vs k' has numbers."""
     rec = sweep.run_point(model="resnet9", method="topk", ratio=0.01,
                           granularity="entiremodel", batch_size=64,
-                          steps=2, warmup=1, devices=8, project_devices=32)
+                          steps=2, warmup=1, devices=8, project_devices=32,
+                          channels_scale=0.125)
     steps_per_sec = 1e3 / rec["step_ms"]
     expect = 2 * 31 / 32 * rec["payload_mb_per_step"] / 1e3 * steps_per_sec
     assert rec["projected_devices"] == 32.0
@@ -48,6 +49,7 @@ def test_run_sweep_cli(mesh8, tmp_path, capsys):
         "--model", "resnet9", "--methods", "terngrad", "--ratios", "0.01",
         "--granularities", "entiremodel", "--batch_size", "64",
         "--steps", "2", "--warmup", "1", "--devices", "8",
+        "--channels_scale", "0.125",
         "--tsv", str(tmp_path / "s.tsv"),
     ])
     records = sweep.run_sweep(args)

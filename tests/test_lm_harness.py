@@ -72,6 +72,24 @@ def test_lm_harness_e2e(tmp_path):
     assert s2["step"] == 26
 
 
+def test_lm_harness_clip_stabilisers(tmp_path):
+    """randomk + EF + momentum with both clip stabilisers on the 3-D mesh:
+    finite loss, training progresses (the EF-momentum protocol the CNN step
+    stabilises, now at LM parity)."""
+    from tpu_compressed_dp.harness import lm
+
+    s = lm.main([
+        "--preset", "tiny", "--dp", "2", "--sp", "2", "--tp", "2",
+        "--steps", "16", "--seq_len", "64", "--global_batch", "8", "--fp32",
+        "--compress", "entiremodel", "--method", "randomk", "--ratio", "0.05",
+        "--error_feedback", "--mode", "wire", "--momentum", "0.9",
+        "--clip_norm", "1.0", "--clip_sent_norm", "1.0", "--log_every", "8",
+    ])
+    assert s["step"] == 16
+    assert math.isfinite(s["loss"])
+    assert s["loss"] < math.log(256) + 1.0
+
+
 def test_lm_harness_validates_flags():
     from tpu_compressed_dp.harness import lm
 

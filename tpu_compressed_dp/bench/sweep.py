@@ -46,12 +46,17 @@ from tpu_compressed_dp.train.step import make_train_step
 __all__ = ["run_point", "run_sweep", "main"]
 
 
-def _build_model(name: str, image_size: int, num_classes: int):
+def _build_model(name: str, image_size: int, num_classes: int,
+                 channels_scale: float = 1.0):
     from tpu_compressed_dp.harness.dawn import MODELS as CIFAR_MODELS
     from tpu_compressed_dp.harness.imagenet import ARCHS as IMAGENET_ARCHS
 
     if name in CIFAR_MODELS:
-        return CIFAR_MODELS[name](), 32, 10
+        if channels_scale != 1.0 and name in ("vgg16", "alexnet_module"):
+            # these constructors have no width knob; building full-width
+            # silently would record timings as if scaled
+            raise ValueError(f"{name} does not support channels_scale")
+        return CIFAR_MODELS[name](channels_scale), 32, 10
     if name in IMAGENET_ARCHS:
         return (
             IMAGENET_ARCHS[name](num_classes=num_classes, dtype=jnp.bfloat16),
@@ -73,6 +78,7 @@ def run_point(
     qstates: int = 255,
     block_size: int = 256,
     bucket_mb: float = 25.0,
+    wire_cap_ratio: float = 0.05,
     error_feedback: bool = False,
     batch_size: int = 512,
     image_size: int = 128,
@@ -81,13 +87,18 @@ def run_point(
     warmup: int = 3,
     devices: Optional[int] = None,
     project_devices: int = 32,
+    channels_scale: float = 1.0,
 ) -> Dict[str, float]:
-    """Measure one grid point; returns a flat record (also JSON-serialisable)."""
+    """Measure one grid point; returns a flat record (also JSON-serialisable).
+
+    ``channels_scale`` shrinks the CIFAR-family nets (width multiplier) —
+    for CI smoke of the record schema on slow hosts, not for real numbers.
+    """
     mesh = make_data_mesh(devices)
     ndev = mesh.shape["data"]
     bs = batch_size if batch_size % ndev == 0 else (batch_size // ndev + 1) * ndev
 
-    module, sz, ncls = _build_model(model, image_size, num_classes)
+    module, sz, ncls = _build_model(model, image_size, num_classes, channels_scale)
     params, stats = init_model(
         module, jax.random.key(0), jnp.zeros((1, sz, sz, 3), jnp.float32)
     )
@@ -97,6 +108,7 @@ def run_point(
     cfg = CompressionConfig(
         method=method, granularity=granularity, mode=mode, ratio=ratio,
         qstates=qstates, block_size=block_size, bucket_mb=bucket_mb,
+        wire_cap_ratio=wire_cap_ratio,
         error_feedback=error_feedback,
     )
     state = TrainState.create(
@@ -150,6 +162,8 @@ def run_point(
         "images_per_sec": round(images_per_sec, 1),
         "images_per_sec_per_chip": round(images_per_sec / ndev, 1),
     }
+    if channels_scale != 1.0:
+        record["channels_scale"] = channels_scale
     if "comm/sent_bits" in metrics:
         payload_mb = float(metrics["comm/sent_bits"]) / 8 / 1e6  # per worker, per step
         dense_mb = float(metrics["comm/dense_elems"]) * 4 / 1e6
@@ -204,6 +218,8 @@ def run_sweep(args) -> List[Dict[str, float]]:
         model=args.model, batch_size=args.batch_size, image_size=args.image_size,
         num_classes=args.num_classes, steps=args.steps, warmup=args.warmup,
         devices=args.devices, project_devices=args.project_devices,
+        channels_scale=args.channels_scale,
+        wire_cap_ratio=args.wire_cap_ratio,
         mode=args.mode, qstates=args.qstates,
         block_size=args.block_size,
         bucket_mb=args.bucket_mb,
@@ -255,6 +271,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--project_devices", type=int, default=32,
                    help="W for the analytic W-chip ring allreduce GB/s "
                         "projection columns (0 disables)")
+    p.add_argument("--channels_scale", type=float, default=1.0,
+                   help="width multiplier for the CIFAR-family nets (CI "
+                        "smoke only; real numbers want 1.0)")
+    p.add_argument("--wire_cap_ratio", type=float, default=0.05,
+                   help="wire thresholdv/adaptive_threshold transport "
+                        "capacity (fraction of elements)")
     p.add_argument("--tsv", type=str, default=None)
     return p
 

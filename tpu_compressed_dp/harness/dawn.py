@@ -100,6 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="blocktopk: elements per contiguous block")
     p.add_argument("--bucket_mb", type=float, default=25.0,
                    help="bucketed granularity: capacity per bucket")
+    p.add_argument("--wire_cap_ratio", type=float, default=0.05,
+                   help="wire thresholdv/adaptive_threshold: transport "
+                        "capacity as a fraction of elements (size via "
+                        "comm/threshold_overflow)")
     p.add_argument("--momentum", type=float, default=0.0)
     p.add_argument("--clip_norm", type=float, default=0.0,
                    help="local-gradient L2 clip (mean-loss units; 0=off) — the "
@@ -256,6 +260,7 @@ def run(args) -> dict:
         qstates=args.qstates,
         block_size=args.block_size,
         bucket_mb=args.bucket_mb,
+        wire_cap_ratio=args.wire_cap_ratio,
         error_feedback=args.error_feedback,
     )
 

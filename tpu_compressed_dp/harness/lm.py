@@ -77,6 +77,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup_steps", type=int, default=10)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--clip_norm", type=float, default=0.0,
+                   help="local-gradient L2 clip (0=off) — EF+momentum "
+                        "stabiliser (see tools/ef_bisect.py)")
+    p.add_argument("--clip_sent_norm", type=float, default=0.0,
+                   help="post-aggregation L2 clip of the synced gradient "
+                        "(bounds the EF residual spike)")
     # compression (same surface as the CNN harnesses)
     p.add_argument("--compress", "-c", default="none", choices=["none", "layerwise", "entiremodel", "bucketed"])
     p.add_argument("--method", default="none")
@@ -87,6 +93,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="blocktopk: elements per contiguous block")
     p.add_argument("--bucket_mb", type=float, default=25.0,
                    help="bucketed granularity: capacity per bucket")
+    p.add_argument("--wire_cap_ratio", type=float, default=0.05,
+                   help="wire thresholdv/adaptive_threshold transport "
+                        "capacity (fraction of elements)")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
     # plumbing
@@ -167,6 +176,7 @@ def run(args) -> Dict[str, float]:
         mode=args.mode, ratio=args.ratio, threshold=args.threshold,
         qstates=args.qstates, block_size=args.block_size,
         bucket_mb=args.bucket_mb,
+        wire_cap_ratio=args.wire_cap_ratio,
         error_feedback=args.error_feedback,
     )
     if pipelined:
@@ -181,7 +191,9 @@ def run(args) -> Dict[str, float]:
             jax.random.key(args.seed + 1),
         )
         train_step = make_pp_train_step(cfg, opt, comp, mesh,
-                                        microbatches=args.microbatches)
+                                        microbatches=args.microbatches,
+                                        clip_norm=args.clip_norm,
+                                        clip_sent_norm=args.clip_sent_norm)
         ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
         if args.resume:
             from tpu_compressed_dp.train.pp_step import place_pp_state
@@ -206,7 +218,9 @@ def run(args) -> Dict[str, float]:
             state = place_lm_state(state, cfg, comp, mesh)
             print(f"resumed step {int(state.step)}")
 
-        train_step = make_lm_train_step(cfg, opt, comp, mesh)
+        train_step = make_lm_train_step(cfg, opt, comp, mesh,
+                                        clip_norm=args.clip_norm,
+                                        clip_sent_norm=args.clip_sent_norm)
     mesh_str = (f"dp{dp}xpp{args.pp}(mb{args.microbatches})" if pipelined
                 else f"dp{dp}xsp{args.sp}xtp{args.tp}")
     print(f"params={n_params/1e6:.1f}M mesh={mesh_str} "

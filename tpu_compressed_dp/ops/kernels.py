@@ -148,10 +148,12 @@ def _count_ge_kernel(lo_ref, hi_ref, x_ref, counts_ref):
 
 
 def _count_edges_kernel(edges_ref, x_ref, counts_ref):
-    """counts[b] += #{x : edges[b] <= x < edges[b+1]} for an ARBITRARY
-    ascending edge array of _HIST_BINS+1 entries in SMEM — the data-adapted
-    first round of the sampled threshold (equispaced bins can't exploit the
-    sample without a branch; quantile edges can)."""
+    """CUMULATIVE counts at arbitrary ascending edges: counts[b] +=
+    #{x : edges[b] <= x < edges[_HIST_BINS]} — i.e. count(>= edges[b]) since
+    the top edge exceeds max(x).  The data-adapted first round of the
+    sampled threshold (equispaced bins can't exploit the sample without a
+    branch; quantile edges can).  17 SMEM edges = 16 bins; the selection
+    compares these cumulative counts directly against keep."""
 
     @pl.when(pl.program_id(0) == 0)
     def _():
@@ -246,7 +248,7 @@ def _topk_threshold_pallas(
     # (global max)*(1+eps) above, so the k-th magnitude ALWAYS falls in some
     # bin — no validity branch, and when the sample is representative
     # (always, in practice) the selected bin is already ~delta ranks wide.
-    # Two equispaced rounds then refine by 16^2.
+    # Four equispaced rounds then refine the selected bin by 16^4.
     #   * sample size targets ~1024 expected survivors so the top_k on the
     #     sample stays cheap at every keep;
     #   * the sample is the first 128 lanes of every C-element block — 512 B

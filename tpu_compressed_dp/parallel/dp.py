@@ -37,7 +37,33 @@ import jax.numpy as jnp
 from tpu_compressed_dp.ops import compressors
 
 __all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
-           "make_leaf_groups", "group_concat", "group_split", "init_ef_state"]
+           "make_leaf_groups", "group_concat", "group_split", "init_ef_state",
+           "make_sharded_clip"]
+
+
+def make_sharded_clip(is_sharded, shard_axis: str):
+    """Build ``clip_tree(tree, limit)`` clipping by the FULL-model L2 norm
+    for gradient trees that mix ``shard_axis``-sharded and replicated leaves
+    (the model-parallel steps' companion to the DP step's inline clip):
+    sharded leaves' squared norms psum over ``shard_axis``; replicated
+    leaves — already psum'd by shard_map AD — count once."""
+    is_sharded = list(is_sharded)
+
+    def global_norm(tree):
+        leaves = jax.tree.leaves(tree)
+        sq_rep = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                     for g, s in zip(leaves, is_sharded) if not s)
+        sq_sh = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                    for g, s in zip(leaves, is_sharded) if s)
+        if any(is_sharded):
+            sq_sh = jax.lax.psum(sq_sh, shard_axis)
+        return jnp.sqrt(sq_rep + sq_sh)
+
+    def clip_tree(tree, limit):
+        factor = jnp.minimum(1.0, limit / jnp.maximum(global_norm(tree), 1e-20))
+        return jax.tree.map(lambda g: g * factor, tree)
+
+    return clip_tree
 
 
 @dataclasses.dataclass(frozen=True)

@@ -40,7 +40,11 @@ from tpu_compressed_dp.models.transformer import (
     param_specs,
     vocab_parallel_xent,
 )
-from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grouped_grad_sync
+from tpu_compressed_dp.parallel.dp import (
+    CompressionConfig,
+    make_grouped_grad_sync,
+    make_sharded_clip,
+)
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import optimizer_lr
@@ -105,12 +109,20 @@ def make_lm_train_step(
     comp_cfg: CompressionConfig,
     mesh: Mesh,
     *,
+    clip_norm: float = 0.0,
+    clip_sent_norm: float = 0.0,
     donate: bool = True,
 ):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``batch``: ``{'input': [B, T] int32, 'target': [B, T] int32}``, ``B``
     divisible by the data axis, ``T`` by the seq axis.
+
+    ``clip_norm`` / ``clip_sent_norm``: the EF-with-momentum stabilisers of
+    :func:`tpu_compressed_dp.train.step.make_train_step` (local-gradient /
+    post-aggregation L2 clip).  Norms span the FULL model gradient: squared
+    norms of tensor-SHARDED leaves psum over the tensor axis; replicated
+    leaves (already psum'd by shard_map AD) count once.
     """
     cfg.validate_mesh(mesh.shape["tensor"])
     sync_axes = ("data", "seq")
@@ -125,6 +137,8 @@ def make_lm_train_step(
     is_sharded = [any(ax == "tensor" for ax in spec) for spec in pspec_leaves]
     grad_sync = make_grouped_grad_sync(comp_cfg, sync_axes, is_sharded, "tensor")
 
+    clip_tree = make_sharded_clip(is_sharded, "tensor")
+
     def local_step(state: TrainState, x: Array, y: Array):
         comp_key = jax.random.fold_in(state.rng, state.step)
 
@@ -138,10 +152,14 @@ def make_lm_train_step(
             lambda p: jax.lax.pcast(p, sync_axes, to="varying"), state.params
         )
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying)
+        if clip_norm > 0.0:
+            grads = clip_tree(grads, clip_norm)
 
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
         synced, new_ef, comm = grad_sync(grads, ef_local, comp_key)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
+        if clip_sent_norm > 0.0:
+            synced = clip_tree(synced, clip_sent_norm)
 
         new_step = state.step + 1
         new_params, new_opt = optimizer.apply(state.params, synced,

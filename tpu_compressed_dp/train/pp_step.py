@@ -51,6 +51,7 @@ from tpu_compressed_dp.ops.ring_attention import ring_attention
 from tpu_compressed_dp.parallel.dp import (
     CompressionConfig,
     make_grouped_grad_sync,
+    make_sharded_clip,
 )
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
@@ -151,6 +152,8 @@ def make_pp_train_step(
     mesh: Mesh,
     *,
     microbatches: int,
+    clip_norm: float = 0.0,
+    clip_sent_norm: float = 0.0,
     donate: bool = True,
 ):
     """Build ``train_step(state, batch) -> (state, metrics)``.
@@ -158,6 +161,11 @@ def make_pp_train_step(
     ``state.params`` must be in stacked form (:func:`stack_layer_params`).
     ``batch['input'|'target']``: [B, T] with ``B`` divisible by
     ``data_size * microbatches``.
+
+    ``clip_norm`` / ``clip_sent_norm``: the EF-with-momentum stabilisers
+    (see :func:`tpu_compressed_dp.train.step.make_train_step`); norms span
+    the full model — pipe-sharded layer stacks psum their squared norms
+    over ``pipe``, replicated embed/head/norm leaves count once.
     """
     stages = mesh.shape["pipe"]
     if cfg.n_layers % stages:
@@ -174,6 +182,8 @@ def make_pp_train_step(
     spec_leaves = jax.tree.leaves(spec_tree, is_leaf=lambda x: isinstance(x, P))
     is_sharded = [any(ax == "pipe" for ax in spec) for spec in spec_leaves]
     grad_sync = make_grouped_grad_sync(comp_cfg, ("data",), is_sharded, "pipe")
+
+    clip_tree = make_sharded_clip(is_sharded, "pipe")
     n_workers = mesh.shape["data"]
     dt = cfg.dtype
 
@@ -230,10 +240,14 @@ def make_pp_train_step(
             lambda p: jax.lax.pcast(p, ("data",), to="varying"), state.params
         )
         loss, grads = jax.value_and_grad(loss_fn)(varying)
+        if clip_norm > 0.0:
+            grads = clip_tree(grads, clip_norm)
 
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
         synced, new_ef, comm = grad_sync(grads, ef_local, comp_key)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
+        if clip_sent_norm > 0.0:
+            synced = clip_tree(synced, clip_sent_norm)
 
         new_step = state.step + 1
         new_params, new_opt = optimizer.apply(state.params, synced,
