@@ -1,0 +1,49 @@
+"""Dataset-tools command assembly (the `IMAGENET/tools/` parity surface:
+EBS replication -> per-worker GCS staging, snapshot -> bucket upload,
+remote tensorboard -> SSH port-forward).  Print-mode only: CI has no gcloud."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "dataset_tools.py")
+
+
+def run(*argv):
+    out = subprocess.run([sys.executable, TOOL, *argv],
+                         capture_output=True, text=True, cwd=REPO)
+    return out
+
+
+def test_stage_fans_rsync_to_all_workers():
+    out = run("stage", "gs://b/imagenet", "/mnt/disks/ssd/imagenet",
+              "--tpu", "pod", "--zone", "us-east5-a")
+    assert out.returncode == 0
+    assert "--worker=all" in out.stdout
+    assert "gcloud storage rsync -r gs://b/imagenet /mnt/disks/ssd/imagenet" in out.stdout
+    assert "mkdir -p" in out.stdout
+
+
+def test_snapshot_is_one_upload():
+    out = run("snapshot", "/data/imagenet", "gs://b/imagenet")
+    assert out.returncode == 0
+    assert out.stdout.strip() == "gcloud storage rsync -r /data/imagenet gs://b/imagenet"
+
+
+def test_tensorboard_port_forwards_worker0():
+    out = run("tensorboard", "logs/tb", "--tpu", "pod", "--zone", "us-east5-a")
+    assert out.returncode == 0
+    assert "--worker=0" in out.stdout
+    assert "-L 6006:localhost:6006" in out.stdout
+
+
+def test_tensorboard_local_without_tpu():
+    out = run("tensorboard", "logs/tb")
+    assert out.returncode == 0
+    assert out.stdout.strip().startswith("tensorboard --logdir=logs/tb")
+
+
+def test_stage_requires_tpu():
+    out = run("stage", "gs://b/x", "/y")
+    assert out.returncode != 0

@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Dataset staging / snapshot / tensorboard utilities — the TPU-native
+equivalents of the reference's AWS dataset tools (`IMAGENET/tools/`).
+
+The reference replicates ImageNet onto one EBS volume per worker
+(`replicate_imagenet.py`: create volume from snapshot, attach, mount) and
+documents a snapshot-creation runbook (`create_imagenet_snapshot.py`).  On
+Cloud TPU the durable copy lives in a GCS bucket (the "snapshot") and the
+per-worker high-performance copy is the TPU-VM's local SSD (the "EBS
+replica"); both reduce to gcloud commands fanned out to every worker of the
+pod slice — same fan-out pattern as tools/launch_tpu.py.  All subcommands
+PRINT the command by default and execute with ``--run`` (the operator may
+not have gcloud auth in this shell).
+
+  # upload a local tree once -> the bucket is the snapshot
+  python tools/dataset_tools.py snapshot /data/imagenet gs://my-bucket/imagenet
+
+  # stage the bucket onto every worker's local disk (one rsync per worker)
+  python tools/dataset_tools.py stage gs://my-bucket/imagenet /mnt/disks/ssd/imagenet \
+      --tpu my-pod --zone us-east5-a
+
+  # tensorboard over the training logdir (the launch_tensorboard.py analog;
+  # TPU-VM port 6006 reached via SSH port-forward instead of a public IP)
+  python tools/dataset_tools.py tensorboard logs/tb --tpu my-pod --zone us-east5-a
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from launch_tpu import tpu_ssh_cmd  # noqa: E402 (shared ssh fan-out builder)
+
+
+def stage_cmd(args) -> list:
+    """Fan `gcloud storage rsync` to all workers: each copies the dataset
+    from GCS to its own local path (the per-worker EBS-replica role)."""
+    inner = (f"mkdir -p {shlex.quote(args.dest)} && "
+             f"gcloud storage rsync -r {shlex.quote(args.src)} {shlex.quote(args.dest)}")
+    return tpu_ssh_cmd(args.tpu, args.zone, "all", inner)
+
+
+def snapshot_cmd(args) -> list:
+    """One upload from wherever the raw tree lives; GCS is the snapshot."""
+    return ["gcloud", "storage", "rsync", "-r", args.src, args.dest]
+
+
+def tensorboard_cmd(args) -> list:
+    """Tensorboard on worker 0 with an SSH port-forward back to the
+    operator (`launch_tensorboard.py` printed a public AWS IP; TPU-VMs
+    aren't publicly routable)."""
+    if args.tpu:
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu,
+            f"--zone={args.zone}", "--worker=0",
+            "--", "-L", f"{args.port}:localhost:{args.port}",
+            f"tensorboard --logdir={shlex.quote(args.logdir)} --port={args.port}",
+        ]
+    return ["tensorboard", f"--logdir={args.logdir}", f"--port={args.port}"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("stage", help="rsync a GCS dataset to every worker's local disk")
+    s.add_argument("src", help="gs://bucket/path")
+    s.add_argument("dest", help="local path on each worker (e.g. /mnt/disks/ssd/imagenet)")
+    s.add_argument("--tpu", required=True)
+    s.add_argument("--zone", default="us-central2-b")
+
+    c = sub.add_parser("snapshot", help="upload a local tree to GCS (the snapshot)")
+    c.add_argument("src")
+    c.add_argument("dest", help="gs://bucket/path")
+
+    t = sub.add_parser("tensorboard", help="tensorboard on worker 0 via SSH port-forward")
+    t.add_argument("logdir")
+    t.add_argument("--tpu", default=None)
+    t.add_argument("--zone", default="us-central2-b")
+    t.add_argument("--port", type=int, default=6006)
+
+    for sp in (s, c, t):
+        sp.add_argument("--run", action="store_true",
+                        help="execute (default: print the command)")
+
+    args = p.parse_args(argv)
+    cmd = {"stage": stage_cmd, "snapshot": snapshot_cmd,
+           "tensorboard": tensorboard_cmd}[args.cmd](args)
+    print(" ".join(shlex.quote(c) for c in cmd))
+    if args.run:
+        return subprocess.call(cmd)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

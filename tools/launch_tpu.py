@@ -34,13 +34,19 @@ import subprocess
 import sys
 
 
+def tpu_ssh_cmd(tpu: str, zone: str, worker: str, command: str) -> list:
+    """The one gcloud TPU-VM ssh invocation every fan-out tool shares
+    (also used by tools/dataset_tools.py)."""
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu,
+        f"--zone={zone}", f"--worker={worker}", f"--command={command}",
+    ]
+
+
 def build_gcloud_cmd(args, train_cmd: list) -> list:
     inner = " ".join(shlex.quote(c) for c in train_cmd)
-    return [
-        "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu,
-        f"--zone={args.zone}", "--worker=all",
-        f"--command=cd {shlex.quote(args.workdir)} && {inner}",
-    ]
+    return tpu_ssh_cmd(args.tpu, args.zone, "all",
+                       f"cd {shlex.quote(args.workdir)} && {inner}")
 
 
 def run_local(args, train_cmd: list) -> int:

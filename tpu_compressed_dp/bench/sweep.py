@@ -52,12 +52,13 @@ def _build_model(name: str, image_size: int, num_classes: int,
     from tpu_compressed_dp.harness.imagenet import ARCHS as IMAGENET_ARCHS
 
     if name in CIFAR_MODELS:
-        if channels_scale != 1.0 and name in ("vgg16", "alexnet_module"):
-            # these constructors have no width knob; building full-width
-            # silently would record timings as if scaled
-            raise ValueError(f"{name} does not support channels_scale")
         return CIFAR_MODELS[name](channels_scale), 32, 10
     if name in IMAGENET_ARCHS:
+        if channels_scale != 1.0:
+            # the ImageNet archs take --width, not a multiplier; building
+            # full-width silently would record timings as if scaled
+            raise ValueError(
+                f"{name} does not support channels_scale (CIFAR-family only)")
         return (
             IMAGENET_ARCHS[name](num_classes=num_classes, dtype=jnp.bfloat16),
             image_size,

@@ -52,6 +52,14 @@ def _scaled(ch: dict, scale: float) -> dict:
     return {k: max(8, int(v * scale)) for k, v in ch.items()}
 
 
+def _fixed_width(name: str, ctor, s: float):
+    # no width knob on these: refuse a non-1 scale instead of silently
+    # building full-width (would mislabel every downstream timing)
+    if s != 1.0:
+        raise ValueError(f"{name} does not support channels_scale")
+    return ctor()
+
+
 MODELS = {
     # channels_scale reproduces the width ablations of the reference's
     # experiments.ipynb (half/double width nets, SURVEY.md §6) and keeps CPU
@@ -64,8 +72,8 @@ MODELS = {
             {"prep": 64, "layer1": 192, "layer2": 384, "layer3": 256, "layer4": 256}, s
         )
     ),
-    "alexnet_module": lambda s=1.0: alexnet_mod.AlexNet(),
-    "vgg16": lambda s=1.0: vgg_mod.vgg16(),
+    "alexnet_module": lambda s=1.0: _fixed_width("alexnet_module", alexnet_mod.AlexNet, s),
+    "vgg16": lambda s=1.0: _fixed_width("vgg16", vgg_mod.vgg16, s),
     # spec-built variants via the graph runtime (`core.py:136`-equivalent)
     "resnet9_graph": lambda s=1.0: _graph_net("resnet9", s),
     "alexnet_graph": lambda s=1.0: _graph_net("alexnet", s),
