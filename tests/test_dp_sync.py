@@ -217,3 +217,30 @@ class TestBucketedGranularity:
     def test_rejects_bad_bucket_mb(self):
         with pytest.raises(ValueError, match="bucket_mb"):
             CompressionConfig(method="topk", granularity="bucketed", bucket_mb=0.0)
+
+
+class TestFusedSimulateEpilogue:
+    def test_fused_topk_path_matches_unfused(self, mesh8, monkeypatch):
+        """The TPU-only fused sparsify epilogue must produce identical synced
+        grads, EF residuals, and comm stats to the unfused chain (forced on
+        via interpret-mode here; CPU CI never dispatches it otherwise)."""
+        import functools
+        from tpu_compressed_dp.ops import kernels
+
+        grads = make_grads(n=700)
+        cfg = CompressionConfig(method="topk", ratio=0.1,
+                                granularity="entiremodel", error_feedback=True)
+        out_ref, ef_ref, stats_ref = run_sync(mesh8, cfg, grads)
+
+        monkeypatch.setattr(kernels, "use_fused_sparsify", lambda n: True)
+        monkeypatch.setattr(kernels, "fused_sparsify",
+                            functools.partial(kernels.fused_sparsify,
+                                              interpret=True))
+        out_f, ef_f, stats_f = run_sync(mesh8, cfg, grads)
+        for k in out_ref:
+            np.testing.assert_allclose(np.asarray(out_ref[k]),
+                                       np.asarray(out_f[k]), rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(ef_ref[k]),
+                                       np.asarray(ef_f[k]), rtol=1e-6)
+        assert float(stats_f["sent_elems"]) == float(stats_ref["sent_elems"])
+        assert float(stats_f["sent_bits"]) == float(stats_ref["sent_bits"])

@@ -195,3 +195,36 @@ print("OK")
                          capture_output=True, text=True)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "OK" in res.stdout
+
+
+class TestFusedSparsify:
+    """The simulate-mode fused epilogue must match the unfused
+    where/subtract/count chain exactly."""
+
+    @pytest.mark.parametrize("want_ef", [True, False])
+    def test_matches_unfused(self, want_ef):
+        n = 5000
+        acc = jax.random.normal(jax.random.key(1), (n,))
+        t = kernels.topk_threshold(jnp.abs(acc), 500)
+        comp, new_ef, cnt = kernels.fused_sparsify(acc, t, want_ef=want_ef,
+                                                   interpret=True)
+        exp_comp = jnp.where(jnp.abs(acc) >= t, acc, 0.0)
+        np.testing.assert_allclose(np.asarray(comp), np.asarray(exp_comp),
+                                   rtol=1e-6)
+        if want_ef:
+            np.testing.assert_allclose(np.asarray(new_ef),
+                                       np.asarray(acc - exp_comp), rtol=1e-6)
+        else:
+            assert new_ef is None
+        assert int(cnt) == int(jnp.count_nonzero(exp_comp))
+
+    def test_zero_threshold_counts_nonzeros_only(self):
+        # t == 0 keeps every real coordinate; the pad tail AND exact zeros
+        # must not count as sent (count_nonzero parity with the unfused path)
+        n = 200  # far from a chunk multiple
+        acc = jnp.ones((n,)).at[7].set(0.0).at[100].set(0.0)
+        comp, new_ef, cnt = kernels.fused_sparsify(
+            acc, jnp.float32(0.0), interpret=True)
+        assert int(cnt) == n - 2
+        np.testing.assert_allclose(np.asarray(comp), np.asarray(acc))
+        np.testing.assert_allclose(np.asarray(new_ef), np.zeros(n))

@@ -343,6 +343,91 @@ def topk_threshold(mag: Array, keep: int) -> Array:
 
 
 # ---------------------------------------------------------------------------
+# Fused sparsify (simulate-mode Top-K / threshold epilogue)
+# ---------------------------------------------------------------------------
+
+
+def _fused_sparsify_kernel(want_ef: bool, n: int, t_ref, x_ref, *refs):
+    """One streaming pass over the accumulated gradient: apply the magnitude
+    threshold and emit the compressed tensor, (optionally) the new EF
+    residual, and the nonzero-survivor count — replacing the where/subtract/
+    count_nonzero pass chain XLA would otherwise run as separate kernels
+    around the pallas threshold call (pallas_call boundaries block fusion).
+    Padding beyond ``n`` is excluded from the count via a global-position
+    mask, and exact zeros never count as sent (matching ``count_nonzero`` on
+    the unfused path even at threshold 0)."""
+    if want_ef:
+        comp_ref, ef_ref, count_ref = refs
+    else:
+        comp_ref, count_ref = refs
+        ef_ref = None
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        count_ref[:] = jnp.zeros_like(count_ref)
+
+    rows, lanes = comp_ref.shape
+    acc = x_ref[:]
+    base = pl.program_id(0) * rows * lanes
+    pos = (base
+           + jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 0) * lanes
+           + jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 1))
+    keep = jnp.logical_and(jnp.abs(acc) >= t_ref[0, 0], pos < n)
+    comp = jnp.where(keep, acc, 0.0)
+    comp_ref[:] = comp
+    if ef_ref is not None:
+        ef_ref[:] = acc - comp
+    sent = jnp.logical_and(keep, acc != 0.0)
+    row = [jnp.sum(sent.astype(jnp.float32))]
+    row += [jnp.float32(0.0)] * (_LANES - 1)
+    count_ref[0, :] += jnp.stack(row)
+
+
+# fat blocks: <=3 streams x 512 rows x 128 lanes x 4 B = <=0.8 MB live VMEM
+# per grid step; fewer grid steps matter — 64-row blocks measured ~8 ms
+# SLOWER at a 100M-element leaf from per-step overhead alone
+_SPARSIFY_ROWS = 512
+
+
+def fused_sparsify(acc: Array, t: Array, *, want_ef: bool = True,
+                   interpret: bool = False):
+    """``(comp, new_ef | None, count)`` keeping coordinates ``|acc| >= t`` —
+    the simulate-mode epilogue fused into one pass over the (already
+    EF-accumulated) gradient.  fp32 in/out: the caller gates dispatch on
+    fp32 inputs so the psum payload dtype matches the unfused path."""
+    n = acc.shape[0]
+    rows = _SPARSIFY_ROWS
+    x2d, num_chunks = _pad_chunks(acc.astype(jnp.float32), fill=0.0, rows=rows)
+    vma = _vma(acc)
+    big = pl.BlockSpec((rows, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    out_specs = [big] + ([big] if want_ef else []) + [
+        pl.BlockSpec((1, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma)]
+    if want_ef:
+        out_shape.append(jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma))
+    out_shape.append(jax.ShapeDtypeStruct((1, _LANES), jnp.float32, vma=vma))
+    outs = pl.pallas_call(
+        functools.partial(_fused_sparsify_kernel, want_ef, n),
+        grid=(num_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            big,
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(t.reshape(1, 1).astype(jnp.float32), x2d)
+    comp = outs[0].reshape(-1)[:n]
+    new_ef = outs[1].reshape(-1)[:n] if want_ef else None
+    return comp, new_ef, outs[-1][0, 0]
+
+
+def use_fused_sparsify(n: int) -> bool:
+    """Whether the fused simulate-mode epilogue should serve this tensor."""
+    return _dispatch_to_pallas(n)
+
+
+# ---------------------------------------------------------------------------
 # Fused stochastic quantisation
 # ---------------------------------------------------------------------------
 

@@ -34,7 +34,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from tpu_compressed_dp.ops import compressors
+from tpu_compressed_dp.ops import compressors, kernels
 
 __all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
            "make_leaf_groups", "group_concat", "group_split", "init_ef_state",
@@ -310,16 +310,33 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         for gi, idxs in enumerate(groups):
             flat = group_concat(leaves, idxs)
             acc = flat + group_concat(ef_leaves, idxs) if use_ef else flat
-            comp_flat = compress_flat(acc, key, gi)
+            n_g = flat.shape[0]
+            if (comp.name == "topk" and acc.dtype == jnp.float32
+                    and kernels.use_fused_sparsify(n_g)):
+                # fused epilogue: threshold-mask + compress + residual +
+                # nonzero count in ONE pass over the accumulated gradient
+                # (pallas_call boundaries block XLA from fusing the
+                # where/subtract/count chain around the threshold kernel).
+                # fp32-gated so the psum payload dtype matches the unfused
+                # path.
+                keep = compressors.topk_keep_count(n_g, cfg.ratio)
+                t = kernels.topk_threshold(jnp.abs(acc), keep)
+                comp_flat, new_ef_flat, group_sent = kernels.fused_sparsify(
+                    acc, t, want_ef=use_ef)
+                group_bits = group_sent * bits_per_elem
+            else:
+                comp_flat = compress_flat(acc, key, gi)
+                new_ef_flat = acc - comp_flat if use_ef else None
+                group_sent = sent_count(comp_flat)
+                group_bits = sent_bits(comp_flat, group_sent)
             reduced = jax.lax.psum(comp_flat, axis_name) / world
             group_split(reduced, leaves, idxs, out_leaves)
             if use_ef:
-                group_split(acc - comp_flat, leaves, idxs, new_ef_leaves,
+                group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
                             dtype=jnp.float32)
-            group_sent = sent_count(comp_flat)
             sent_total = sent_total + group_sent
-            bits_total = bits_total + sent_bits(comp_flat, group_sent)
-            dense_total += float(flat.shape[0])
+            bits_total = bits_total + group_bits
+            dense_total += float(n_g)
 
         out = jax.tree.unflatten(treedef, out_leaves)
         new_ef = jax.tree.unflatten(treedef, new_ef_leaves) if use_ef else ()
