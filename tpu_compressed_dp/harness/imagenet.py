@@ -231,6 +231,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bucketed granularity: capacity per bucket")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
+    p.add_argument("--wire_cap_ratio", type=float, default=0.05,
+                   help="wire thresholdv/adaptive_threshold transport "
+                        "capacity (fraction of elements)")
+    p.add_argument("--clip_norm", type=float, default=0.0,
+                   help="local-gradient L2 clip (0=off) — EF+momentum "
+                        "stabiliser (see tools/ef_bisect.py)")
+    p.add_argument("--clip_sent_norm", type=float, default=0.0,
+                   help="post-aggregation L2 clip of the synced gradient "
+                        "(bounds the EF residual spike)")
     p.add_argument("--devices", type=int, default=None)
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("--seed", type=int, default=2147483647)  # `train_imagenet_nv.py:82`
@@ -320,6 +329,7 @@ def run(args) -> Dict[str, float]:
         mode=args.mode, ratio=args.ratio, threshold=args.threshold,
         qstates=args.qstates, block_size=args.block_size,
         bucket_mb=args.bucket_mb,
+        wire_cap_ratio=args.wire_cap_ratio,
         error_feedback=args.error_feedback,
     )
     state = TrainState.create(
@@ -341,7 +351,9 @@ def run(args) -> Dict[str, float]:
             ckpt.best_metric = restore.best_metric
         print(f"resumed step {int(state.step)} (epoch {start_epoch})")
 
-    train_step = make_train_step(apply_fn, opt, comp, mesh, grad_scale=1.0)
+    train_step = make_train_step(apply_fn, opt, comp, mesh, grad_scale=1.0,
+                                 clip_norm=args.clip_norm,
+                                 clip_sent_norm=args.clip_sent_norm)
     eval_step = make_eval_step(apply_fn, mesh)
 
     def validate(state) -> Dict[str, float]:

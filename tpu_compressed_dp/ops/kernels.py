@@ -54,6 +54,8 @@ __all__ = [
     "set_pallas_mode",
     "pallas_mode",
     "topk_threshold",
+    "fused_sparsify",
+    "use_fused_sparsify",
     "qsgd_quantize",
     "terngrad_quantize",
     "MIN_PALLAS_ELEMS",
@@ -378,8 +380,10 @@ def _fused_sparsify_kernel(want_ef: bool, n: int, t_ref, x_ref, *refs):
     if ef_ref is not None:
         ef_ref[:] = acc - comp
     sent = jnp.logical_and(keep, acc != 0.0)
-    row = [jnp.sum(sent.astype(jnp.float32))]
-    row += [jnp.float32(0.0)] * (_LANES - 1)
+    # int32 accumulation: fp32 partial sums round past 2^24 sent elements,
+    # drifting from the unfused path's integer-exact count_nonzero
+    row = [jnp.sum(sent.astype(jnp.int32))]
+    row += [jnp.int32(0)] * (_LANES - 1)
     count_ref[0, :] += jnp.stack(row)
 
 
@@ -405,7 +409,7 @@ def fused_sparsify(acc: Array, t: Array, *, want_ef: bool = True,
     out_shape = [jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma)]
     if want_ef:
         out_shape.append(jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma))
-    out_shape.append(jax.ShapeDtypeStruct((1, _LANES), jnp.float32, vma=vma))
+    out_shape.append(jax.ShapeDtypeStruct((1, _LANES), jnp.int32, vma=vma))
     outs = pl.pallas_call(
         functools.partial(_fused_sparsify_kernel, want_ef, n),
         grid=(num_chunks,),
@@ -419,7 +423,7 @@ def fused_sparsify(acc: Array, t: Array, *, want_ef: bool = True,
     )(t.reshape(1, 1).astype(jnp.float32), x2d)
     comp = outs[0].reshape(-1)[:n]
     new_ef = outs[1].reshape(-1)[:n] if want_ef else None
-    return comp, new_ef, outs[-1][0, 0]
+    return comp, new_ef, outs[-1][0, 0].astype(jnp.float32)
 
 
 def use_fused_sparsify(n: int) -> bool:

@@ -82,7 +82,7 @@ class CompressionConfig:
                    reference DDP's 25 MB bucketing, `ddp.py:188,238-241`,
                    computed statically at trace time).  Recommendation for
                    layer-wise semantics at scale: 'bucketed' — single-chip
-                   step time matches 'layerwise' (VGG-16: 40.7 vs 40.9 ms,
+                   step time matches 'layerwise' (VGG-16: 42.3 vs 42.7 ms,
                    benchmarks/vgg16_bucketed_r2.tsv) while cutting the
                    collective count ~5x (32 -> 7 on VGG-16, 161 -> 5 on
                    ResNet-50), which is what matters once psums ride real
