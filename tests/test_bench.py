@@ -21,27 +21,50 @@ def test_run_point_topk_layerwise(mesh8):
     assert 0.005 < rec["sent_frac"] < 0.05  # ~1% + tiny-tensor rounding
     assert rec["payload_mb_per_step"] < rec["dense_mb_per_step"] * 0.05
     assert rec["num_collectives"] > 1
-    # ring model: 2(W-1)/W x payload at the measured rate
+    # topk's wire form all_gathers worker-distinct payloads: per-chip link
+    # traffic is (W-1) x payload (VERDICT r2 #2), not the ring 2(W-1)/W
+    assert rec["transport"] == "all_gather"
     steps_per_sec = 1e3 / rec["step_ms"]
-    expect = 2 * 7 / 8 * rec["payload_mb_per_step"] / 1e3 * steps_per_sec
+    expect = 7 * rec["payload_mb_per_step"] / 1e3 * steps_per_sec
     assert abs(rec["allreduce_gbps_per_chip"] - expect) < max(0.05 * expect, 0.01)
 
 
 def test_run_point_projected_comm_columns(mesh8):
     """VERDICT r1 weak #6: single-chip sweeps must still report the analytic
-    W-chip ring projection so 'allreduce GB/s vs k' has numbers."""
+    W-chip projection so 'allreduce GB/s vs k' has numbers — with the
+    method-aware transport factor (VERDICT r2 #2)."""
     rec = sweep.run_point(model="resnet9", method="topk", ratio=0.01,
                           granularity="entiremodel", batch_size=64,
                           steps=2, warmup=1, devices=8, project_devices=32,
                           channels_scale=0.125)
     steps_per_sec = 1e3 / rec["step_ms"]
-    expect = 2 * 31 / 32 * rec["payload_mb_per_step"] / 1e3 * steps_per_sec
+    expect = 31 * rec["payload_mb_per_step"] / 1e3 * steps_per_sec
     assert rec["projected_devices"] == 32.0
     assert rec["projected_allreduce_gbps_per_chip"] > 0
     assert abs(rec["projected_allreduce_gbps_per_chip"] - expect) <= max(
         0.05 * expect, 0.01)
     assert (rec["projected_dense_allreduce_gbps_per_chip"]
             > rec["projected_allreduce_gbps_per_chip"])
+
+
+def test_projection_method_aware_topk_vs_randomk(mesh8):
+    """VERDICT r2 #2 done-criterion: at W>2 and equal ratio, topk (all_gather,
+    64 bits/elem) must project strictly more per-chip traffic than shared-seed
+    randomk (packed ring psum, 32 bits/elem) — before this fix both were
+    billed the ring factor and differed only by the index bits."""
+    common = dict(model="resnet9", granularity="entiremodel", mode="wire",
+                  ratio=0.01, batch_size=64, steps=2, warmup=1, devices=8,
+                  project_devices=32, channels_scale=0.125)
+    rec_t = sweep.run_point(method="topk", **common)
+    rec_r = sweep.run_point(method="randomk", **common)
+    assert rec_t["transport"] == "all_gather"
+    assert rec_r["transport"] == "psum"
+    # same keep count, 2x wire width, (W-1) vs 2(W-1)/W factor: ~32x at W=32
+    ratio = (rec_t["projected_allreduce_gbps_per_chip"]
+             / rec_r["projected_allreduce_gbps_per_chip"])
+    # normalise out the measured step-rate difference between the two runs
+    ratio *= rec_t["step_ms"] / rec_r["step_ms"]
+    assert 25.0 < ratio < 40.0
 
 
 def test_run_sweep_cli(mesh8, tmp_path, capsys):

@@ -62,6 +62,21 @@ GRID = [
     ("blocktopk-em-1%-wire", ["--compress", "entiremodel", "--method",
                               "blocktopk", "--ratio", "0.01",
                               "--error_feedback", "--mode", "wire"]),
+    # --- r3: the reference's ACTUAL sparsified-DDP operating regime -------
+    # (VERDICT r2 #1): ImageNet step schedule (train.py:60-72), momentum 0.9
+    # (train_imagenet_nv.py:49), Random-K + EF (sparsified_ddp.py:408-413).
+    # The EF-spike analysis (benchmarks/ef_momentum_bisect_r3.txt) puts the
+    # stable peak ~10x below dense's; dense-step-mom.9 at the same shape is
+    # the control.
+    ("dense-step", ["--lr_schedule", "step", "--peak_lr", "0.4"]),
+    ("randomk-em-1%-wire-EF-step", [
+        "--compress", "entiremodel", "--method", "randomk", "--ratio", "0.01",
+        "--error_feedback", "--mode", "wire",
+        "--lr_schedule", "step", "--peak_lr", "0.04"]),
+    ("topk-em-1%-wire-EF-step", [
+        "--compress", "entiremodel", "--method", "topk", "--ratio", "0.01",
+        "--error_feedback", "--mode", "wire",
+        "--lr_schedule", "step", "--peak_lr", "0.04"]),
 ]
 
 COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",

@@ -59,10 +59,48 @@ def make_data(seed: int = 0, n: int = 4096, dim: int = 64, classes: int = 10,
 
 # ---------------------------------------------------------------- JAX side
 
+def lr_value(schedule: str, peak_lr: float, steps: int, batch: int,
+             step: int) -> float:
+    """Per-step lr in summed-loss units — ONE scalar implementation consumed
+    by both the JAX arm (via a host-built table) and the torch arm, so the
+    two bisect arms can never train under different curves.
+
+    'dawn'  — the CIFAR protocol's triangle: ramp to peak at 1/8, anneal to 0
+              (`dawn.py:110`).
+    'step'  — the reference's ImageNet shape (`IMAGENET/train.py:60-72`):
+              linear warmup over the first 1/8, flat at peak to 60%, peak/10
+              to 85%, peak/100 after — the regime the reference actually ran
+              `RandomKSparsifiedDDP` under (`train_imagenet_nv.py:203-222`).
+    """
+    warm = max(1, steps // 8)
+    if schedule == "dawn":
+        return max(min(peak_lr * step / warm,
+                       peak_lr * (steps - step) / (steps - warm)), 0.0) / batch
+    if schedule != "step":
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if step < warm:
+        return peak_lr * step / warm / batch
+    if step < 0.6 * steps:
+        return peak_lr / batch
+    if step < 0.85 * steps:
+        return peak_lr / 10.0 / batch
+    return peak_lr / 100.0 / batch
+
+
+def make_lr_fn(schedule: str, peak_lr: float, steps: int, batch: int):
+    """Traced-step lr lookup for the JAX arm: the scalar schedule evaluated
+    on host into a table, indexed inside `lax.scan`."""
+    import jax.numpy as jnp
+
+    table = jnp.asarray([lr_value(schedule, peak_lr, steps, batch, s)
+                         for s in range(steps)], jnp.float32)
+    return lambda step: table[step]
+
+
 def run_jax(momentum: float, nesterov: bool, ef: bool, ef_style: str,
             method: str, ratio: float, steps: int, peak_lr: float,
             batch: int = 512, seed: int = 0, clip: float = 0.0,
-            warmup_sparsity: bool = False):
+            warmup_sparsity: bool = False, schedule: str = "dawn"):
     """Train the MLP under the dawn summed-loss protocol; return per-step loss."""
     import jax
     import jax.numpy as jnp
@@ -80,12 +118,7 @@ def run_jax(momentum: float, nesterov: bool, ef: bool, ef_style: str,
 
     # dawn protocol scaling (`dawn.py:142-148`): summed loss, lr/bs, wd*bs
     wd = 5e-4 * batch
-    warm = max(1, steps // 8)
-
-    def lr_at(step):
-        up = peak_lr * step / warm
-        down = peak_lr * (steps - step) / (steps - warm)
-        return jnp.maximum(jnp.where(step < warm, up, down), 0.0) / batch
+    lr_at = make_lr_fn(schedule, peak_lr, steps, batch)
 
     def loss_fn(p, xb, yb):
         h = jnp.maximum(xb @ p["w1"], 0.0)
@@ -191,7 +224,8 @@ def run_jax(momentum: float, nesterov: bool, ef: bool, ef_style: str,
 # -------------------------------------------------------------- torch side
 
 def run_torch(momentum: float, nesterov: bool, ratio: float, steps: int,
-              peak_lr: float, batch: int = 512, seed: int = 0):
+              peak_lr: float, batch: int = 512, seed: int = 0,
+              schedule: str = "dawn"):
     """The reference's own arithmetic: per-parameter Random-K EF via
     masked_select/masked_fill (`sparsified_ddp.py:408-413`) + torch.optim.SGD
     momentum (`train_imagenet_nv.py:186-191`), world size 1."""
@@ -217,8 +251,7 @@ def run_torch(momentum: float, nesterov: bool, ratio: float, steps: int,
     gen = torch.Generator().manual_seed(2147483647)  # the reference seed
     losses = []
     for step in range(steps):
-        lr = max(min(peak_lr * step / warm,
-                     peak_lr * (steps - step) / (steps - warm)), 0.0) / batch
+        lr = lr_value(schedule, peak_lr, steps, batch, step)
         for gparam in opt.param_groups:
             gparam["lr"] = lr
         i = torch.randint(0, n, (batch,))
@@ -249,13 +282,57 @@ def summarize(name: str, losses: np.ndarray) -> str:
             f"max={losses.max():.2f}  last10={losses[-10:].mean():.4f}")
 
 
+def run_operating_point(args):
+    """VERDICT r2 #1: map the reference's ACTUAL operating regime — the
+    ImageNet step schedule (`IMAGENET/train.py:60-72`), not just dawn's
+    triangle — over peak lr x EF flavor, all at momentum 0.9 (the reference's
+    `--momentum` default, `train_imagenet_nv.py:48`), Random-K k=1% + EF."""
+    rows = []
+    print(f"# operating-point map: schedule={args.schedule} steps={args.steps} "
+          f"k={args.ratio}", flush=True)
+    for peak in (0.4, 0.2, 0.1, 0.05, 0.02):
+        dense = run_jax(0.9, True, False, "plain", "randomk", 1.0, args.steps,
+                        peak, schedule=args.schedule)
+        rows.append(summarize(f"dense       mom=.9 peak={peak}", dense))
+        print(rows[-1], flush=True)
+        for label, style, clip, warm in (
+            ("plain-EF   ", "plain", 0.0, False),
+            ("plain-EF+clip", "plain", 1.0, False),
+            ("DGC        ", "momentum", 0.0, False),
+            ("DGC+warmup ", "momentum", 0.0, True),
+            ("plain+warmup", "plain", 0.0, True),
+        ):
+            losses = run_jax(0.9, True, True, style, "randomk", args.ratio,
+                             args.steps, peak, clip=clip, warmup_sparsity=warm,
+                             schedule=args.schedule)
+            rows.append(summarize(
+                f"randomk+{label} mom=.9 peak={peak}", losses))
+            print(rows[-1], flush=True)
+        if not args.skip_torch:
+            losses = run_torch(0.9, True, args.ratio, args.steps, peak,
+                               schedule=args.schedule)
+            rows.append(summarize(
+                f"TORCH ref-rule randomk+EF mom=.9 peak={peak}", losses))
+            print(rows[-1], flush=True)
+    return rows
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=640)
     ap.add_argument("--peak_lr", type=float, default=0.4)
     ap.add_argument("--ratio", type=float, default=0.01)
     ap.add_argument("--skip_torch", action="store_true")
+    ap.add_argument("--schedule", choices=["dawn", "step"], default="dawn",
+                    help="'step' = the reference's ImageNet warmup->step-decay "
+                         "shape (train.py:60-72)")
+    ap.add_argument("--operating_point", action="store_true",
+                    help="sweep peak lr x EF flavor at momentum 0.9 under "
+                         "--schedule (VERDICT r2 #1)")
     args = ap.parse_args(argv)
+
+    if args.operating_point:
+        return run_operating_point(args)
 
     rows = []
     cases = [
@@ -283,16 +360,16 @@ def main(argv=None):
     for label, mom, nest, ef, style, method in cases:
         if method == "dense":
             losses = run_jax(0.9, True, False, "plain", "randomk", 1.0,
-                             args.steps, args.peak_lr)
+                             args.steps, args.peak_lr, schedule=args.schedule)
         else:
             losses = run_jax(mom, nest, ef, style, method, args.ratio,
-                             args.steps, args.peak_lr)
+                             args.steps, args.peak_lr, schedule=args.schedule)
         rows.append(summarize(label, losses))
         print(rows[-1], flush=True)
     for label, mom, nest, style, method, clip, warm in clip_cases:
         losses = run_jax(mom, nest, True, style, method, args.ratio,
                          args.steps, args.peak_lr, clip=clip,
-                         warmup_sparsity=warm)
+                         warmup_sparsity=warm, schedule=args.schedule)
         rows.append(summarize(label, losses))
         print(rows[-1], flush=True)
 
@@ -302,7 +379,8 @@ def main(argv=None):
             ("TORCH reference-rule randomk+EF mom=.9 plain", 0.9, False),
             ("TORCH reference-rule randomk+EF mom=0", 0.0, False),
         ]:
-            losses = run_torch(mom, nest, args.ratio, args.steps, args.peak_lr)
+            losses = run_torch(mom, nest, args.ratio, args.steps,
+                               args.peak_lr, schedule=args.schedule)
             rows.append(summarize(label, losses))
             print(rows[-1], flush=True)
     return rows

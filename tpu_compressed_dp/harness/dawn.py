@@ -122,9 +122,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "(bounds the EF residual spike; see tools/ef_bisect.py)")
     p.add_argument("--mode", type=str, default="simulate", choices=["simulate", "wire"])
     p.add_argument("--error_feedback", action="store_true")
+    p.add_argument("--ratio_warmup_epochs", type=int, default=0,
+                   help="DGC-style sparsity warm-up (Lin et al., ICLR'18): "
+                        "keep-ratio decays geometrically from ~dense to "
+                        "--ratio over the first N epochs (epoch-level, one "
+                        "recompile per distinct ratio).  Early training — "
+                        "where EF x momentum spikes are most destructive — "
+                        "runs near-dense; only topk/randomk/blocktopk")
     p.add_argument("--epochs", type=int, default=None, help="override the 24/40 rule")
     p.add_argument("--batch_size", type=int, default=512)
     p.add_argument("--peak_lr", type=float, default=0.4)
+    p.add_argument("--lr_schedule", type=str, default="dawn",
+                   choices=["dawn", "step"],
+                   help="'dawn' = the CIFAR triangle (`dawn.py:110`); 'step' = "
+                        "the reference's ImageNet shape (warmup to peak, flat, "
+                        "peak/10 at 60%%, peak/100 at 85%% — `train.py:60-72`), "
+                        "the regime the reference actually ran sparsified DDP "
+                        "under.  EF + momentum needs 'step' with a ~10x lower "
+                        "peak than dawn's (see benchmarks/ef_momentum_bisect_r3)")
     p.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     p.add_argument("--synthetic", action="store_true", help="synthetic data smoke run")
     p.add_argument("--synthetic_hard", action="store_true",
@@ -250,7 +265,24 @@ def run(args) -> dict:
     # short (smoke) runs the ramp point is pulled in so the knots stay strictly
     # increasing and the schedule still anneals to 0.
     ramp_ep = 5 if epochs > 5 else epochs / 2
-    sched = piecewise_linear([0, ramp_ep, epochs], [0, args.peak_lr, 0])
+    if args.lr_schedule == "step":
+        # the ImageNet shape (`train.py:60-72`) expressed through the same
+        # phase DSL the ImageNet harness uses: warmup -> flat peak -> /10 at
+        # 60% -> /100 at 85%.  Warmup spans the first 1/8 of training (the
+        # reference's 5-of-~35; a fixed 5 would cross the 60% boundary on
+        # short runs and fold the knot sequence non-monotone)
+        from tpu_compressed_dp.train.schedules import lr_phases_to_knots
+
+        ramp_s = epochs / 8.0
+        knots, vals = lr_phases_to_knots([
+            {"ep": (0, ramp_s), "lr": (0.0, args.peak_lr)},
+            {"ep": ramp_s, "lr": args.peak_lr},
+            {"ep": 0.6 * epochs, "lr": args.peak_lr / 10.0},
+            {"ep": 0.85 * epochs, "lr": args.peak_lr / 100.0},
+        ])
+        sched = piecewise_linear(knots, vals)
+    else:
+        sched = piecewise_linear([0, ramp_ep, epochs], [0, args.peak_lr, 0])
     lr = lambda step: sched(step / steps_per_epoch) / bs  # noqa: E731 (`dawn.py:142`)
     opt = SGD(
         lr=lr,
@@ -259,18 +291,36 @@ def run(args) -> dict:
         weight_decay=5e-4 * bs,
     )
 
-    comp = CompressionConfig(
-        method=None if args.compress == "none" or args.method.lower() == "none" else args.method,
-        granularity=args.compress if args.compress != "none" else "layerwise",
-        mode=args.mode,
-        ratio=args.ratio,
-        threshold=args.threshold,
-        qstates=args.qstates,
-        block_size=args.block_size,
-        bucket_mb=args.bucket_mb,
-        wire_cap_ratio=args.wire_cap_ratio,
-        error_feedback=args.error_feedback,
-    )
+    def comp_for_ratio(ratio: float) -> CompressionConfig:
+        return CompressionConfig(
+            method=None if args.compress == "none" or args.method.lower() == "none" else args.method,
+            granularity=args.compress if args.compress != "none" else "layerwise",
+            mode=args.mode,
+            ratio=ratio,
+            threshold=args.threshold,
+            qstates=args.qstates,
+            block_size=args.block_size,
+            bucket_mb=args.bucket_mb,
+            wire_cap_ratio=args.wire_cap_ratio,
+            error_feedback=args.error_feedback,
+        )
+
+    comp = comp_for_ratio(args.ratio)
+
+    def ratio_for_epoch(epoch: int) -> float:
+        # geometric decay target^((e+1)/N) -> target over the warm-up, rounded
+        # to 2 significant digits so close epochs share a compile
+        from tpu_compressed_dp.ops.compressors import canonical_name
+
+        n_w = args.ratio_warmup_epochs
+        if (n_w <= 0 or epoch >= n_w or comp.method is None
+                or canonical_name(comp.method) not in ("topk", "randomk", "blocktopk")):
+            return args.ratio
+        r = args.ratio ** ((epoch + 1) / n_w)
+        from math import floor, log10
+
+        digits = -int(floor(log10(abs(r)))) + 1
+        return min(1.0, round(r, digits))
 
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp, ndev),
@@ -281,9 +331,18 @@ def run(args) -> dict:
         mean=np.asarray(data.CIFAR10_MEAN) * 255.0,
         std=np.asarray(data.CIFAR10_STD) * 255.0,
     )
-    train_step = make_train_step(apply_fn, opt, comp, mesh, grad_scale=float(bs),
-                                 clip_norm=args.clip_norm,
-                                 clip_sent_norm=args.clip_sent_norm)
+
+    step_cache: dict = {}
+
+    def train_step_for(ratio: float):
+        if ratio not in step_cache:
+            step_cache[ratio] = make_train_step(
+                apply_fn, opt, comp_for_ratio(ratio), mesh,
+                grad_scale=float(bs), clip_norm=args.clip_norm,
+                clip_sent_norm=args.clip_sent_norm)
+        return step_cache[ratio]
+
+    train_step = train_step_for(ratio_for_epoch(0))
     eval_step = make_eval_step(apply_fn, mesh)
 
     # epoch summaries print master-only, like the reference's rank-0-gated
@@ -307,6 +366,7 @@ def run(args) -> dict:
         profiling = args.profile_epoch == epoch and args.log_dir
         if profiling:
             jax.profiler.start_trace(os.path.join(args.log_dir, "profile"))
+        train_step = train_step_for(ratio_for_epoch(epoch))
         state, epoch_stats = train_epoch(
             train_step, eval_step, state, train_batches, test_batches, timer, bs,
             test_time_in_total=False,

@@ -55,6 +55,8 @@ __all__ = [
     "blocktopk_num_blocks",
     "blocktopk_keep_blocks",
     "terngrad_levels",
+    "terngrad_dense",
+    "terngrad_num_chunks",
     "qsgd_levels",
     "leaf_key",
 ]
@@ -246,34 +248,83 @@ def adaptive_threshold(g: Array, key: Optional[Array] = None) -> Array:
     return jnp.where(2.0 * jnp.abs(g) >= gmax, g, 0.0)
 
 
-def terngrad_levels(g: Array, key: Array) -> tuple[Array, Array]:
+def terngrad_num_chunks(n: int, chunk: int) -> int:
+    """Scale chunks TernGrad uses: 1 (scalar global max) when chunking is off
+    or the vector fits in one chunk, else ``ceil(n / chunk)``."""
+    if chunk <= 0 or n <= chunk:
+        return 1
+    return -(-n // chunk)
+
+
+def terngrad_levels(g: Array, key: Array, *, chunk: int = 0) -> tuple[Array, Array]:
     """TernGrad's integer representation: ``(levels int8 in {-1,0,1}, scale)``.
 
     The dense estimator is ``scale * levels``; the wire path transmits the
-    int8 levels + one scale instead.  A zero gradient maps to zero levels
+    int8 levels + the scale(s) instead.  A zero gradient maps to zero levels
     (the reference would produce NaN via 0/0; SURVEY.md §2.3).
+
+    ``chunk > 0`` bounds the scale granularity: one max per ``chunk``
+    elements (``scale`` comes back as a ``[num_chunks]`` vector).  This is
+    the resolution of the entire-model blow-up (VERDICT r2): a single
+    ``max|g|`` over millions of parameters drives every keep-probability
+    ``|g_i|/max|g|`` toward zero and the estimator variance unbounded — the
+    reference's entire-model path was dead code (SURVEY.md §2.3.2), so there
+    is no working behavior to match; chunked scales give the entire-model
+    granularity layer-wise-like statistics while still transporting int8
+    levels + a negligible ``32*num_chunks`` bits of scales.
     """
     g = _flat(g)
+    n = g.shape[0]
     from tpu_compressed_dp.ops import kernels
 
-    if kernels.use_quant_kernels(g.shape[0]):
-        return kernels.terngrad_quantize(g, key)
-    mag = jnp.abs(g)
-    gmax = jnp.max(mag)
-    prob = jnp.where(gmax > 0, mag / jnp.where(gmax > 0, gmax, 1.0), 0.0)
-    coin = jax.random.uniform(key, g.shape, dtype=g.dtype)
-    levels = (jnp.sign(g) * (coin < prob)).astype(jnp.int8)
+    if terngrad_num_chunks(n, chunk) == 1:
+        if kernels.use_quant_kernels(n):
+            return kernels.terngrad_quantize(g, key)
+        mag = jnp.abs(g)
+        gmax = jnp.max(mag)
+        prob = jnp.where(gmax > 0, mag / jnp.where(gmax > 0, gmax, 1.0), 0.0)
+        coin = jax.random.uniform(key, g.shape, dtype=g.dtype)
+        levels = (jnp.sign(g) * (coin < prob)).astype(jnp.int8)
+        return levels, gmax
+    # chunked: normalise each chunk by its own max, then ternarise the
+    # prescaled vector with unit scale (one extra elementwise pass; the
+    # quantisation pass itself is unchanged)
+    nc = terngrad_num_chunks(n, chunk)
+    pad = nc * chunk - n
+    g2 = jnp.pad(g.astype(jnp.float32), (0, pad)).reshape(nc, chunk)
+    gmax = jnp.max(jnp.abs(g2), axis=1)                      # [nc]
+    inv = jnp.where(gmax > 0, 1.0 / jnp.where(gmax > 0, gmax, 1.0), 0.0)
+    scaled = (g2 * inv[:, None]).reshape(-1)[:n]             # |scaled| <= 1
+    if kernels.use_quant_kernels(n):
+        levels = kernels.terngrad_quantize_prescaled(scaled, key)
+    else:
+        coin = jax.random.uniform(key, (n,), dtype=jnp.float32)
+        levels = (jnp.sign(scaled) * (coin < jnp.abs(scaled))).astype(jnp.int8)
     return levels, gmax
 
 
-def terngrad(g: Array, key: Array) -> Array:
+def terngrad_dense(levels: Array, scale: Array, chunk: int,
+                   dtype=jnp.float32) -> Array:
+    """Reassemble the dense estimator from ``terngrad_levels`` output
+    (broadcasting per-chunk scales when ``scale`` is a vector)."""
+    if scale.ndim == 0:
+        return scale.astype(dtype) * levels.astype(dtype)
+    n = levels.shape[0]
+    nc = scale.shape[0]
+    pad = nc * chunk - n
+    lv = jnp.pad(levels, (0, pad)).reshape(nc, chunk).astype(dtype)
+    return (scale.astype(dtype)[:, None] * lv).reshape(-1)[:n]
+
+
+def terngrad(g: Array, key: Array, *, chunk: int = 0) -> Array:
     """TernGrad ternarisation (`core.py:200-206`).
 
     ``out_i = max|g| * sign(g_i) * Bernoulli(|g_i| / max|g|)`` — an unbiased
-    estimator of ``g``.
+    estimator of ``g``; the max is per ``chunk`` elements when chunking is on
+    (see :func:`terngrad_levels`).
     """
-    levels, scale = terngrad_levels(g, key)
-    return scale * levels.astype(g.dtype)
+    levels, scale = terngrad_levels(g, key, chunk=chunk)
+    return terngrad_dense(levels, scale, chunk, dtype=g.dtype)
 
 
 def qsgd_levels(g: Array, key: Array, *, qstates: int = 255) -> tuple[Array, Array]:
@@ -381,6 +432,17 @@ REGISTRY = ("none", "topk", "blocktopk", "randomk", "thresholdv",
             "adaptive_threshold", "terngrad", "qsgd")
 
 
+def canonical_name(method: Optional[str]) -> str:
+    """Resolve a method spelling (canonical or reference CLI alias) to its
+    canonical name; raises on unknown spellings like :func:`get_compressor`."""
+    if method is None:
+        return "none"
+    canon = _ALIASES.get(method.lower().replace("-", "_"))
+    if canon is None:
+        raise ValueError(f"unknown compression method {method!r}; known: {REGISTRY}")
+    return canon
+
+
 def get_compressor(
     method: Optional[str],
     *,
@@ -388,6 +450,7 @@ def get_compressor(
     threshold: float = 1e-3,
     qstates: int = 255,
     block_size: int = 256,
+    terngrad_chunk: int = 1 << 21,
 ) -> _Bound:
     """Resolve a method name (canonical or reference spelling) to a bound op.
 
@@ -395,11 +458,7 @@ def get_compressor(
     dense there; here they raise, since silent fallthrough hid the reference's
     'enitremodel' bug (SURVEY.md §2.3).
     """
-    if method is None:
-        method = "none"
-    canon = _ALIASES.get(method.lower().replace("-", "_"))
-    if canon is None:
-        raise ValueError(f"unknown compression method {method!r}; known: {REGISTRY}")
+    canon = canonical_name(method)
     if canon == "none":
         return _Bound("none", lambda g, key=None: identity(g), needs_rng=False)
     if canon == "topk":
@@ -419,7 +478,11 @@ def get_compressor(
     if canon == "adaptive_threshold":
         return _Bound("adaptive_threshold", lambda g, key=None: adaptive_threshold(g), needs_rng=False)
     if canon == "terngrad":
-        return _Bound("terngrad", terngrad, needs_rng=True)
+        return _Bound(
+            "terngrad",
+            lambda g, key: terngrad(g, key, chunk=terngrad_chunk),
+            needs_rng=True,
+        )
     if canon == "qsgd":
         return _Bound("qsgd", lambda g, key: random_dithering(g, key, qstates=qstates), needs_rng=True)
     raise AssertionError(canon)

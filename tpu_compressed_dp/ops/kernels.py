@@ -58,6 +58,7 @@ __all__ = [
     "use_fused_sparsify",
     "qsgd_quantize",
     "terngrad_quantize",
+    "terngrad_quantize_prescaled",
     "MIN_PALLAS_ELEMS",
 ]
 
@@ -183,6 +184,10 @@ def _topk_threshold_pallas(
     sample_init: bool = True,
 ) -> Array:
     n = mag.shape[0]
+    # clamp BEFORE the sampled-init rank arithmetic: keep > n would give
+    # lo_rank > hi_rank and an IndexError at trace time in sv[rk] (the exact
+    # path already clamps via keep_f; mirror it here)
+    keep = min(keep, n)
     x2d, num_chunks = _pad_chunks(mag.astype(jnp.float32), fill=-1.0,
                                   rows=_HIST_ROWS)
 
@@ -524,6 +529,16 @@ def terngrad_quantize(flat: Array, key: Array, *,
         _terngrad_kernel, jnp.int8, flat, inv, _seed_from_key(key), interpret,
     )
     return levels, gmax
+
+
+def terngrad_quantize_prescaled(scaled: Array, key: Array, *,
+                                interpret: bool = False) -> Array:
+    """TernGrad levels for an already chunk-normalised input (``|x| <= 1``,
+    unit scale) — the chunked-scale path's quantisation pass."""
+    return _run_quant(
+        _terngrad_kernel, jnp.int8, scaled,
+        jnp.asarray(1.0, jnp.float32), _seed_from_key(key), interpret,
+    )
 
 
 def use_quant_kernels(n: int) -> bool:

@@ -152,7 +152,8 @@ def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world
     return dense, idx, agree
 
 
-def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world):
+def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world,
+                    want_surplus: bool = False):
     # threshold-select + hierarchical pack instead of lax.top_k's full sort;
     # near-threshold membership can differ from exact top-k by a few elements
     # at the histogram's final-bin resolution (error feedback reabsorbs the
@@ -162,7 +163,8 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world):
 
     mag = jnp.abs(flat).astype(jnp.float32)
     t = kernels.topk_threshold(mag, keep)
-    idx = packed_indices_from_mask(mag >= t, keep)
+    mask = mag >= t
+    idx = packed_indices_from_mask(mask, keep)
     payload = flat[idx]                                   # [k] values + [k] indices travel
     g_vals = _all_gather(payload, axis_name)       # [W, k]
     g_idx = _all_gather(idx, axis_name)            # [W, k]
@@ -172,7 +174,12 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world):
         .add(g_vals.reshape(-1))
         / world
     )
-    return dense, idx
+    # above-threshold survivors beyond `keep` (histogram bin-resolution ties/
+    # surplus) are truncated by ascending index; with EF off they are silently
+    # dropped — surface the count so callers can see it (ADVICE r2)
+    surplus = (jnp.maximum(jnp.sum(mask, dtype=jnp.int32) - keep, 0)
+               if want_surplus else None)
+    return dense, idx, surplus
 
 
 def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
@@ -243,11 +250,23 @@ def _leaf_sync_threshold(flat: Array, v, cap: int, axis_name: str, world,
     return dense, new_ef, sent_count, overflow
 
 
-def _leaf_sync_terngrad(flat: Array, key: Array, axis_name: str, world):
-    levels, scale = compressors.terngrad_levels(flat, key)
+def _leaf_sync_terngrad(flat: Array, key: Array, chunk: int, axis_name: str,
+                        world):
+    levels, scale = compressors.terngrad_levels(flat, key, chunk=chunk)
     g_levels = _all_gather(levels, axis_name)             # [W, n] int8
-    g_scale = _all_gather(scale, axis_name)               # [W]
-    dense = jnp.sum(g_scale[:, None] * g_levels.astype(flat.dtype), axis=0) / world
+    g_scale = _all_gather(scale, axis_name)               # [W] or [W, nc]
+    if scale.ndim == 0:
+        dense = jnp.sum(
+            g_scale[:, None] * g_levels.astype(flat.dtype), axis=0) / world
+        return dense
+    # chunked scales: broadcast each worker's [nc] scales over its chunks
+    n = flat.shape[0]
+    nc = scale.shape[0]
+    pad = nc * chunk - n
+    lv = jnp.pad(g_levels, ((0, 0), (0, pad))).reshape(-1, nc, chunk)
+    dense = jnp.sum(
+        g_scale[:, :, None] * lv.astype(flat.dtype), axis=0
+    ).reshape(-1)[:n] / world
     return dense
 
 
@@ -269,6 +288,7 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
     comp = compressors.get_compressor(
         cfg.method, ratio=cfg.ratio, threshold=cfg.threshold,
         qstates=cfg.qstates, block_size=cfg.block_size,
+        terngrad_chunk=cfg.terngrad_chunk,
     )
     if comp.name not in WIRE_METHODS:
         raise NotImplementedError(
@@ -341,7 +361,14 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             dense, idx, agree = _leaf_sync_randomk(
                 acc, key, keep, axis_name, world, check)
         elif comp.name == "topk":
-            dense, idx = _leaf_sync_topk(acc, keep, axis_name, world)
+            # with EF on the surplus is reabsorbed by the residual; with EF
+            # off it is a real (silent) drop — count and report it
+            dense, idx, surplus = _leaf_sync_topk(
+                acc, keep, axis_name, world, want_surplus=ef_flat is None)
+            if surplus is not None:
+                new_ef = None
+                return (dense, new_ef, float(keep), leaf_bits(n, keep),
+                        agree, surplus)
         elif comp.name == "blocktopk":
             if keep >= flat.shape[0]:
                 # every block selected (leaves <= block_size always are, and
@@ -357,7 +384,8 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
                     world, ef_flat is not None)
             return dense, new_ef, float(keep), leaf_bits(n, keep), agree, None
         elif comp.name == "terngrad":
-            dense = _leaf_sync_terngrad(acc, key, axis_name, world)
+            dense = _leaf_sync_terngrad(
+                acc, key, cfg.terngrad_chunk, axis_name, world)
         else:  # qsgd
             dense = _leaf_sync_qsgd(acc, key, cfg.qstates, axis_name, world)
         # EF residual = the coordinates that did NOT travel; zeroing the sent
@@ -370,6 +398,7 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
     def sync(grads: Any, ef: Any, key: Array) -> Tuple[Any, Any, Dict[str, Array]]:
         from tpu_compressed_dp.parallel.dp import (
             BUCKET_MB, group_concat, group_split, make_leaf_groups,
+            wire_rides_psum,
         )
 
         world = jax.lax.psum(1, axis_name)
@@ -389,6 +418,8 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         overflows = []
         sent = 0.0
         bits = 0.0
+        bits_psum = 0.0
+        bits_ag = 0.0
         dense_total = 0.0
         for gi, idxs in enumerate(groups):
             flat = group_concat(leaves, idxs)
@@ -396,6 +427,12 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             ki = compressors.leaf_key(key, gi, per_worker_rng, axis_name)
             dense, new_ef_flat, sent_leaf, bits_leaf, agree, overflow = (
                 sync_flat(flat, ef_flat, ki, world))
+            # which collective this group's payload actually rode (VERDICT
+            # r2 #2) — shared predicate with the simulate engine
+            if wire_rides_psum(comp.name, flat.shape[0], cfg):
+                bits_psum += bits_leaf
+            else:
+                bits_ag += bits_leaf
             group_split(dense, leaves, idxs, out_leaves)
             if use_ef:
                 # EF residual is fp32 by design (see group_split docstring)
@@ -412,14 +449,20 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         stats = {
             "sent_elems": jnp.asarray(sent, jnp.float32),
             "sent_bits": jnp.asarray(bits, jnp.float32),
+            "sent_bits_psum": jnp.asarray(bits_psum, jnp.float32),
+            "sent_bits_allgather": jnp.asarray(bits_ag, jnp.float32),
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
             "num_collectives": jnp.asarray(float(len(groups)), jnp.float32),
         }
         if agrees:
             stats["sync_agree"] = jnp.min(jnp.stack(agrees))
         if overflows:
-            # survivors clipped by the fixed capacity (0 = cap was enough)
-            stats["threshold_overflow"] = jnp.sum(
+            # threshold methods: survivors clipped by the fixed capacity
+            # (0 = cap was enough).  Top-K without EF: above-threshold
+            # survivors beyond keep, truncated by ascending index (ADVICE r2).
+            key_name = ("topk_surplus_dropped" if comp.name == "topk"
+                        else "threshold_overflow")
+            stats[key_name] = jnp.sum(
                 jnp.stack(overflows)).astype(jnp.float32)
         out = jax.tree.unflatten(treedef, out_leaves)
         new_ef = jax.tree.unflatten(treedef, new_ef_leaves) if use_ef else ()
