@@ -1,0 +1,42 @@
+"""MFU / analytic-FLOPs tests (VERDICT r2 #3)."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_compressed_dp.utils import flops as F
+
+
+class _FakeDev:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+def test_transformer_formula():
+    # 6N dominates at seq << d; attention term = 12*L*d*s
+    n_params, L, d, s = 1_000_000, 4, 256, 128
+    got = F.transformer_train_flops_per_token(n_params, L, d, s)
+    assert got == 6.0 * n_params + 12.0 * L * d * s
+
+
+def test_peak_prefix_match():
+    assert F.chip_peak_flops(_FakeDev("TPU v5 lite")) == 197e12  # not v5p
+    assert F.chip_peak_flops(_FakeDev("TPU v5p")) == 459e12
+    assert F.chip_peak_flops(_FakeDev("TPU v4")) == 275e12
+    assert F.chip_peak_flops(_FakeDev("Graphcore IPU")) is None
+
+
+def test_mfu_none_off_tpu():
+    assert F.mfu(1e12, _FakeDev("weird")) is None
+    assert F.mfu(98.5e12, _FakeDev("TPU v5 lite")) == 0.5
+
+
+def test_fwd_flops_xla_matmul():
+    # 2*M*N*K FLOPs for a matmul, per XLA's own cost model; abstract args
+    def f(a, b):
+        return a @ b
+
+    a = jnp.zeros((64, 32), jnp.float32)
+    b = jnp.zeros((32, 16), jnp.float32)
+    got = F.fwd_flops_xla(f, a, b)
+    if got is not None:  # backend exposes a cost model
+        assert got == 2 * 64 * 32 * 16

@@ -246,7 +246,6 @@ def run_torch(momentum: float, nesterov: bool, ratio: float, steps: int,
     opt = torch.optim.SGD(model.parameters(), lr=0.0, momentum=momentum,
                           nesterov=nesterov and momentum > 0, weight_decay=wd)
     crit = torch.nn.CrossEntropyLoss(reduction="sum")
-    warm = max(1, steps // 8)
     eps = [torch.zeros(p.numel()) for p in model.parameters()]
     gen = torch.Generator().manual_seed(2147483647)  # the reference seed
     losses = []

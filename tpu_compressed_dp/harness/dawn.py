@@ -342,7 +342,6 @@ def run(args) -> dict:
                 clip_sent_norm=args.clip_sent_norm)
         return step_cache[ratio]
 
-    train_step = train_step_for(ratio_for_epoch(0))
     eval_step = make_eval_step(apply_fn, mesh)
 
     # epoch summaries print master-only, like the reference's rank-0-gated

@@ -176,6 +176,14 @@ def make_pp_train_step(
                          "configs require moe_every=1")
     layers_per_stage = cfg.n_layers // stages
     M = microbatches
+    if M % stages:
+        import warnings
+
+        warnings.warn(
+            f"microbatches ({M}) not divisible by pipe size ({stages}): the "
+            "deferred LM head falls back to every stage heading the full "
+            "drained batch — correct, but S x the logits memory and head "
+            "FLOPs of the even-split fast path", stacklevel=2)
     # pipe-sharded layer stacks vs pipe-replicated embed/head/norm sync as
     # separate groups (see make_grouped_grad_sync)
     spec_tree = pp_state_specs(cfg, comp_cfg).params
@@ -204,8 +212,7 @@ def make_pp_train_step(
                     h = _decoder_layer(cfg, lp, h, pos)
                 return h
 
-            def tick(t, carry):
-                h_cur, loss_sum, tok_sum = carry
+            def tick(h_cur, t):
                 # stage 0 injects microbatch t (clamped; masked by `inject`)
                 inject = (stage == 0) & (t < M)
                 x_t = xs[jnp.clip(t, 0, M - 1)]
@@ -213,27 +220,43 @@ def make_pp_train_step(
                 emb = jax.lax.pcast(emb, ("pipe",), to="varying")
                 h_in = jnp.where(inject, emb, h_cur)
                 h_out = stage_apply(h_in)
-                # last stage emits microbatch t - (S-1)
-                out_idx = t - (stages - 1)
-                emit = (stage == stages - 1) & (out_idx >= 0) & (out_idx < M)
-                y_t = ys[jnp.clip(out_idx, 0, M - 1)]
-                hn = _rms_norm(h_out, params["final_norm"], cfg.norm_eps)
-                logits = hn @ params["lm_head"].astype(dt)
-                nll = vocab_parallel_xent(logits, y_t)
-                loss_sum = loss_sum + jnp.where(emit, nll, 0.0)
-                tok_sum = tok_sum + jnp.where(emit, 1.0, 0.0)
                 h_next = jax.lax.ppermute(h_out, "pipe", perm)
-                return h_next, loss_sum, tok_sum
+                return h_next, h_out
 
             h0 = jax.lax.pcast(jnp.zeros((mb, t_len, cfg.dim), dt),
                                ("data", "pipe"), to="varying")
-            zero = jax.lax.pcast(jnp.zeros((), jnp.float32),
-                                 ("data", "pipe"), to="varying")
-            _, loss_sum, tok_sum = jax.lax.fori_loop(
-                0, M + stages - 1, tick, (h0, zero, zero))
-            # mean over microbatches; share from the last stage to all
-            loss = jax.lax.psum(loss_sum, "pipe") / jnp.maximum(
-                jax.lax.psum(tok_sum, "pipe"), 1.0)
+            _, h_ticks = jax.lax.scan(tick, h0, jnp.arange(M + stages - 1))
+            # The final-norm + LM-head + loss are DEFERRED past the loop
+            # (VERDICT r2 #6): the last stage emits microbatch j at tick
+            # S-1+j, so its drained activations are a STATIC slice of the
+            # scan's stacked outputs — no scatter in the loop, no extra
+            # carry for AD to checkpoint.  In the tick loop every stage paid
+            # the head M+S-1 times (ramp ticks on zero activations
+            # included); here the drained activations are psum-broadcast
+            # over `pipe` (activations are [*, d] — small next to [*, V]
+            # logits) and each stage heads M/S microbatches, so the head
+            # costs M/S passes wall-clock and the logits buffer stays S x
+            # smaller than a whole-batch head pass.
+            emitted = h_ticks[stages - 1:stages - 1 + M]       # [M, mb, T, d]
+            emitted = jax.lax.psum(
+                jnp.where(stage == stages - 1, emitted,
+                          jnp.zeros_like(emitted)), "pipe")
+            if M % stages == 0:
+                m_s = M // stages
+                my_h = jax.lax.dynamic_slice_in_dim(emitted, stage * m_s, m_s)
+                my_y = jax.lax.dynamic_slice_in_dim(
+                    jax.lax.pcast(ys, ("pipe",), to="varying"),
+                    stage * m_s, m_s)
+                scale = 1.0 / stages
+            else:  # uneven split: every stage heads the full drained set
+                m_s, my_h, scale = M, emitted, 1.0 / stages
+                my_y = jax.lax.pcast(ys, ("pipe",), to="varying")
+            hn = _rms_norm(my_h.reshape(m_s * mb, t_len, cfg.dim),
+                           params["final_norm"], cfg.norm_eps)
+            logits = hn @ params["lm_head"].astype(dt)
+            nll = vocab_parallel_xent(logits, my_y.reshape(m_s * mb, t_len))
+            # equal chunks: mean of chunk-means == global mean
+            loss = jax.lax.psum(nll * scale, "pipe")
             return loss
 
         varying = jax.tree.map(
