@@ -193,3 +193,121 @@ def test_imagenet_harness_e2e(tmp_path):
     # resume from the stored checkpoint and run evaluate-only
     stats = h.main(argv + ["--resume", str(tmp_path / "ck"), "--evaluate"])
     assert stats["count"] > 0
+
+
+def _make_image_tree(root, n_classes=3, per_class=8, seed=0):
+    """Write a torchvision-layout tree with varied sizes/ARs to disk."""
+    import os
+
+    from PIL import Image as PILImage
+
+    rng = np.random.default_rng(seed)
+    for ci in range(n_classes):
+        cdir = root / f"class_{ci:02d}"
+        os.makedirs(cdir, exist_ok=True)
+        for j in range(per_class):
+            w = int(rng.integers(24, 72))
+            h = int(rng.integers(24, 72))
+            arr = np.full((h, w, 3), 40 * ci + 20, np.uint8)
+            arr += rng.integers(0, 20, arr.shape).astype(np.uint8)
+            PILImage.fromarray(arr).save(cdir / f"img_{j:03d}.png")
+
+
+class TestImageFolderSizeCache:
+    def test_cold_scan_then_warm_load(self, tmp_path, monkeypatch):
+        """VERDICT r2 #7: the AR index persists; a warm start opens ZERO
+        image files for size planning."""
+        from tpu_compressed_dp.data import imagenet as inet
+
+        _make_image_tree(tmp_path / "train")
+        ds = inet.ImageFolder(str(tmp_path / "train"))
+        wh = ds.sizes_bulk()
+        assert wh.shape == (24, 2)
+        cache = tmp_path / "train" / inet.ImageFolder.SIZE_CACHE
+        assert cache.exists()
+
+        # warm: a fresh instance must satisfy sizes_bulk from the cache only
+        ds2 = inet.ImageFolder(str(tmp_path / "train"))
+        opens = []
+        real_open = inet.Image.open
+        monkeypatch.setattr(inet.Image, "open",
+                            lambda *a, **k: opens.append(a) or real_open(*a, **k))
+        wh2 = ds2.sizes_bulk()
+        assert opens == []
+        np.testing.assert_array_equal(np.asarray(wh), np.asarray(wh2))
+        # and size(i) agrees with a direct header read
+        with real_open(ds2.samples[5][0]) as im:
+            assert ds2.size(5) == im.size
+
+    def test_stale_cache_rescans(self, tmp_path):
+        from PIL import Image as PILImage
+
+        from tpu_compressed_dp.data import imagenet as inet
+
+        _make_image_tree(tmp_path / "train")
+        ds = inet.ImageFolder(str(tmp_path / "train"))
+        ds.sizes_bulk()
+        # add a file: the sample list changes, cache must be ignored
+        extra = tmp_path / "train" / "class_00" / "img_zzz.png"
+        PILImage.fromarray(np.zeros((10, 30, 3), np.uint8)).save(extra)
+        ds2 = inet.ImageFolder(str(tmp_path / "train"))
+        wh = ds2.sizes_bulk()
+        assert wh.shape == (25, 2)
+        idx = [i for i, (p, _) in enumerate(ds2.samples)
+               if p.endswith("img_zzz.png")][0]
+        assert ds2.size(idx) == (30, 10)
+
+    def test_readonly_root_falls_back_to_home_cache(self, tmp_path, monkeypatch):
+        # chmod can't model a read-only mount when tests run as root (root
+        # bypasses permission bits) — fail the in-tree write directly
+        from tpu_compressed_dp.data import imagenet as inet
+
+        _make_image_tree(tmp_path / "train")
+        monkeypatch.setenv("HOME", str(tmp_path / "home"))
+        root = str(tmp_path / "train")
+        ds = inet.ImageFolder(root)
+        real_savez = np.savez_compressed
+
+        def savez(path, **kw):
+            if str(path).startswith(root):
+                raise OSError(30, "Read-only file system", str(path))
+            return real_savez(path, **kw)
+
+        monkeypatch.setattr(np, "savez_compressed", savez)
+        ds.sizes_bulk()
+        home_caches = list((tmp_path / "home").rglob("sizes-*.npz"))
+        assert len(home_caches) == 1
+        ds2 = inet.ImageFolder(root)
+        assert ds2._load_size_cache() is not None
+
+
+def test_imagenet_harness_e2e_imagefolder(tmp_path):
+    """On-disk ImageFolder end-to-end (VERDICT r2 #7): train + rect-val
+    through the smoke schedule's two image sizes, driven by real files."""
+    from tpu_compressed_dp.harness import imagenet as h
+
+    _make_image_tree(tmp_path / "data" / "train", per_class=32)
+    _make_image_tree(tmp_path / "data" / "validation", per_class=8, seed=5)
+    import json
+
+    phases = [
+        {"ep": 0, "sz": 64, "bs": 32},
+        {"ep": [0, 1], "lr": [0.1, 0.2]},
+        {"ep": 1, "lr": 0.1},
+        {"ep": 2, "sz": 96, "bs": 16, "rect_val": True},
+        {"ep": [2, 3], "lr": [0.01, 0.001]},
+    ]
+    argv = [
+        str(tmp_path / "data"),
+        "--phases", json.dumps(phases),
+        "--num_classes", "3", "--arch", "resnet18", "--width", "16",
+        "--short_epoch", "--workers", "2", "--seed", "3",
+    ]
+    summary = h.main(argv)
+    assert summary["epoch"] == 2  # smoke schedule: 64px then 96px rect-val
+    assert np.isfinite(summary["train loss"])
+    assert summary["top5"] >= 0.0
+    # the rect-val planning persisted its AR index next to the data
+    from tpu_compressed_dp.data.imagenet import ImageFolder
+
+    assert (tmp_path / "data" / "validation" / ImageFolder.SIZE_CACHE).exists()

@@ -228,3 +228,23 @@ class TestFusedSparsify:
         assert int(cnt) == n - 2
         np.testing.assert_allclose(np.asarray(comp), np.asarray(acc))
         np.testing.assert_allclose(np.asarray(new_ef), np.zeros(n))
+
+
+def test_topk_threshold_jnp_fallback_guarantee():
+    """The pure-jnp histogram (the >int32 fallback) keeps the structural
+    count(mag >= t) >= keep guarantee with tie-resolution surplus only."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_compressed_dp.ops.kernels import _topk_threshold_jnp
+
+    for seed, n, keep in [(0, 4096, 41), (1, 1000, 1), (2, 8192, 8000)]:
+        mag = jnp.abs(jax.random.normal(jax.random.key(seed), (n,)))
+        t = _topk_threshold_jnp(mag, keep)
+        cnt = int(jnp.sum(mag >= t))
+        assert cnt >= keep
+        exact = float(jax.lax.top_k(mag, keep)[0][-1])
+        # threshold within the refinement resolution of the exact k-th value
+        assert float(t) <= exact
+        assert cnt <= keep + max(8, int(0.01 * n))

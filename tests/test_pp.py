@@ -169,3 +169,37 @@ def test_pp_checkpoint_resume(tmp_path):
     # EF residual survived the round-trip (it is part of the checkpoint)
     for a, b in zip(jax.tree.leaves(cont.ef), jax.tree.leaves(resumed.ef)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("dp,pp,tp,mb", [(1, 2, 2, 2), (2, 2, 2, 4), (1, 2, 4, 2)])
+def test_pipeline_tensor_composition_matches_single_device(dp, pp, tp, mb):
+    """pipe x tensor (VERDICT r2 #9): megatron sharding inside each stage
+    must leave the loss equal to the unsharded single-device forward."""
+    cfg = _cfg(n_kv_heads=4) if tp == 4 else _cfg()
+    x = jax.random.randint(jax.random.key(1), (4 * dp * mb, 16), 0, 64)
+    y = jax.random.randint(jax.random.key(2), (4 * dp * mb, 16), 0, 64)
+    ref = float(tf.vocab_parallel_xent(tf.apply_llama(cfg, tf.init_llama(
+        cfg, jax.random.key(0)), x), y))
+    mesh = make_pp_mesh(dp, pp, tp)
+    _, state, step = _setup(cfg, mesh, CompressionConfig(method=None),
+                            microbatches=mb)
+    _, m = step(state, {"input": x, "target": y})
+    assert float(m["loss"]) == pytest.approx(ref, rel=1e-5)
+
+
+def test_pipeline_tensor_learns_with_compression():
+    cfg = _cfg()
+    mesh = make_pp_mesh(2, 2, 2)
+    comp = CompressionConfig(method="topk", granularity="entiremodel",
+                             ratio=0.1, error_feedback=True)
+    _, state, step = _setup(cfg, mesh, comp, lr=0.3, microbatches=2)
+    x = jax.random.randint(jax.random.key(4), (8, 16), 0, 64)
+    y = jnp.roll(x, -1, axis=1)
+    first = last = None
+    for i in range(30):
+        state, m = step(state, {"input": x, "target": y})
+        if i == 0:
+            first = float(m["loss"])
+        last = float(m["loss"])
+    assert last < first * 0.7
+    assert float(m["comm/sent_elems"]) < float(m["comm/dense_elems"]) * 0.2

@@ -134,13 +134,15 @@ def run(args) -> Dict[str, float]:
     distributed_init(args.coordinator, args.num_processes, args.process_id)
     ndev = len(jax.devices())
     pipelined = args.pp > 1
-    if pipelined and (args.sp != 1 or args.tp != 1):
-        raise ValueError("--pp composes with --dp only (set --sp 1 --tp 1)")
+    if pipelined and args.sp != 1:
+        raise ValueError("--pp composes with --dp and --tp (set --sp 1); "
+                         "sequence sharding lives in the (data, seq, tensor) "
+                         "step")
     dp = args.dp if args.dp is not None else ndev // (args.sp * args.tp * args.pp)
     if pipelined:
         from tpu_compressed_dp.train.pp_step import make_pp_mesh
 
-        mesh = make_pp_mesh(dp, args.pp)
+        mesh = make_pp_mesh(dp, args.pp, args.tp)
     else:
         mesh = make_lm_mesh(dp, args.sp, args.tp)
     cfg = build_config(args)
@@ -221,7 +223,7 @@ def run(args) -> Dict[str, float]:
         train_step = make_lm_train_step(cfg, opt, comp, mesh,
                                         clip_norm=args.clip_norm,
                                         clip_sent_norm=args.clip_sent_norm)
-    mesh_str = (f"dp{dp}xpp{args.pp}(mb{args.microbatches})" if pipelined
+    mesh_str = (f"dp{dp}xpp{args.pp}xtp{args.tp}(mb{args.microbatches})" if pipelined
                 else f"dp{dp}xsp{args.sp}xtp{args.tp}")
     print(f"params={n_params/1e6:.1f}M mesh={mesh_str} "
           f"seq={args.seq_len} batch={args.global_batch} "

@@ -331,12 +331,61 @@ def _topk_threshold_pallas(
     return lo
 
 
+_INT32_MAX = (1 << 31) - 1
+
+
+def _topk_threshold_jnp(mag: Array, keep: int, rounds: int = 7) -> Array:
+    """Pure-jnp histogram refinement — the Pallas kernel's algorithm without
+    the kernel: 16 bins per round via one bucketize + scatter-add pass (not
+    16 per-edge compare passes), 7 rounds -> threshold resolved to
+    ``max|g| / 2^28``.  The fallback for sizes where ``lax.top_k`` would
+    overflow its int32 indices (> 2^31 elements: the 8B entire-model
+    groups), and for abstract evaluation of those configs off-TPU.
+
+    Counts accumulate in float32, whose ulp at 2^32 is 512 — the bin
+    selection therefore targets ``keep + margin`` with ``margin`` a few
+    float32 ulps of n, so cumulative-count rounding can only ADD surplus
+    (threshold a hair low), never break ``count(mag >= t) >= keep``.
+    """
+    n = mag.shape[0]
+    mag = mag.astype(jnp.float32)
+    # conservative target: fp32 summation error is bounded by a few ulps of
+    # the running total; 8 ulps of n keeps the guarantee one-sided
+    margin = 8.0 * n / float(1 << 23) if n > (1 << 23) else 0.0
+    keep_f = jnp.float32(min(keep + margin, n))
+    lo = jnp.float32(0.0)
+    hi = (jnp.max(mag) * 1.0000002 + 1e-30).astype(jnp.float32)
+    above = jnp.float32(0.0)
+    for _ in range(rounds):
+        width = (hi - lo) / _HIST_BINS
+        idx = jnp.clip(((mag - lo) / width).astype(jnp.int32),
+                       0, _HIST_BINS - 1)
+        valid = (mag >= lo) & (mag < hi)
+        hist = jnp.zeros((_HIST_BINS,), jnp.float32).at[
+            jnp.where(valid, idx, 0)].add(valid.astype(jnp.float32))
+        # counts[b] = #{x : x >= edge_b, x < hi} = suffix sum of the hist
+        counts = jnp.cumsum(hist[::-1])[::-1]
+        total_ge = above + counts
+        b = jnp.clip(jnp.sum((total_ge >= keep_f).astype(jnp.int32)) - 1,
+                     0, _HIST_BINS - 1)
+        new_lo = lo + width * b.astype(jnp.float32)
+        new_hi = jnp.where(b == _HIST_BINS - 1, hi,
+                           lo + width * (b + 1).astype(jnp.float32))
+        counts_next = jnp.concatenate([counts, jnp.zeros((1,), jnp.float32)])
+        above = above + jnp.where(
+            b == _HIST_BINS - 1, 0.0,
+            counts_next[jnp.clip(b + 1, 0, _HIST_BINS)])
+        lo, hi = new_lo, new_hi
+    return lo
+
+
 def topk_threshold(mag: Array, keep: int) -> Array:
     """Magnitude threshold keeping ``>= keep`` elements (ties included).
 
     Exact (``lax.top_k``) below the dispatch cutoff or off-TPU; histogram
-    kernel above it.  Either way ``count(mag >= t) >= keep`` with surplus
-    only from ties at the returned threshold's resolution.
+    kernel above it; pure-jnp histogram beyond int32 sizes.  Either way
+    ``count(mag >= t) >= keep`` with surplus only from ties at the returned
+    threshold's resolution.
     """
     n = mag.shape[0]
     if keep >= n:
@@ -346,6 +395,8 @@ def topk_threshold(mag: Array, keep: int) -> Array:
         # dtype could round UP past the true k-th magnitude and break the
         # count(mag >= t) >= keep guarantee
         return _topk_threshold_pallas(mag, keep)
+    if n > _INT32_MAX:
+        return _topk_threshold_jnp(mag, keep)
     return jax.lax.top_k(mag.astype(jnp.float32), keep)[0][-1]
 
 
@@ -432,8 +483,12 @@ def fused_sparsify(acc: Array, t: Array, *, want_ef: bool = True,
 
 
 def use_fused_sparsify(n: int) -> bool:
-    """Whether the fused simulate-mode epilogue should serve this tensor."""
-    return _dispatch_to_pallas(n)
+    """Whether the fused simulate-mode epilogue should serve this tensor.
+
+    Above int32 sizes the kernel's global-position iota would wrap — the
+    unfused path (threshold + where) handles those (XLA indexes with s64
+    where needed)."""
+    return _dispatch_to_pallas(n) and n <= _INT32_MAX
 
 
 # ---------------------------------------------------------------------------

@@ -346,6 +346,13 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         static."""
         acc = flat + ef_flat if ef_flat is not None else flat
         n = flat.shape[0]
+        if n > (1 << 31) - 1 and comp.name not in ("terngrad", "qsgd"):
+            # the packed index pipeline is int32 throughout (32-bit indices
+            # ARE the wire format); groups beyond int32 must be cut smaller
+            raise ValueError(
+                f"wire-mode {comp.name} group of {n} elements exceeds int32 "
+                "index range; use granularity='bucketed' (25 MB buckets) or "
+                "'layerwise' for models this large")
         keep = leaf_keep(n)
         agree = None
         idx = None

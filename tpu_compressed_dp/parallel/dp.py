@@ -62,29 +62,37 @@ def wire_rides_psum(name: str, n: int, cfg: "CompressionConfig") -> bool:
     return False
 
 
-def make_sharded_clip(is_sharded, shard_axis: str):
+def make_partitioned_clip(leaf_axes):
     """Build ``clip_tree(tree, limit)`` clipping by the FULL-model L2 norm
-    for gradient trees that mix ``shard_axis``-sharded and replicated leaves
-    (the model-parallel steps' companion to the DP step's inline clip):
-    sharded leaves' squared norms psum over ``shard_axis``; replicated
-    leaves — already psum'd by shard_map AD — count once."""
-    is_sharded = list(is_sharded)
+    for gradient trees whose leaves are sharded over per-leaf model-axis
+    subsets (``leaf_axes`` aligned with ``jax.tree.leaves`` order; ``()`` =
+    replicated, already psum'd by shard_map AD, counts once).  Squared
+    norms accumulate per signature and psum once per signature."""
+    leaf_axes = [tuple(a) for a in leaf_axes]
+    sigs = sorted(set(leaf_axes))
 
     def global_norm(tree):
         leaves = jax.tree.leaves(tree)
-        sq_rep = sum(jnp.sum(g.astype(jnp.float32) ** 2)
-                     for g, s in zip(leaves, is_sharded) if not s)
-        sq_sh = sum(jnp.sum(g.astype(jnp.float32) ** 2)
-                    for g, s in zip(leaves, is_sharded) if s)
-        if any(is_sharded):
-            sq_sh = jax.lax.psum(sq_sh, shard_axis)
-        return jnp.sqrt(sq_rep + sq_sh)
+        total = jnp.zeros((), jnp.float32)
+        for sig in sigs:
+            sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                     for g, a in zip(leaves, leaf_axes) if a == sig)
+            if sig:
+                sq = jax.lax.psum(sq, sig)
+            total = total + sq
+        return jnp.sqrt(total)
 
     def clip_tree(tree, limit):
         factor = jnp.minimum(1.0, limit / jnp.maximum(global_norm(tree), 1e-20))
         return jax.tree.map(lambda g: g * factor, tree)
 
     return clip_tree
+
+
+def make_sharded_clip(is_sharded, shard_axis):
+    """Binary convenience wrapper over :func:`make_partitioned_clip`."""
+    axes = (shard_axis,) if isinstance(shard_axis, str) else tuple(shard_axis)
+    return make_partitioned_clip([axes if s else () for s in is_sharded])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -404,49 +412,69 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     return sync
 
 
-def make_grouped_grad_sync(cfg: CompressionConfig, sync_axes, is_sharded,
-                           shard_axis: str):
-    """Compressed sync for gradient trees that mix model-axis-SHARDED leaves
-    with model-axis-REPLICATED ones (tensor or pipeline parallelism).
+def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
+                               leaf_axes) -> Any:
+    """Compressed sync for gradient trees whose leaves are sharded over
+    different subsets of model axes (tensor / pipeline parallelism, and
+    their composition).
 
-    Compression masks are data-dependent, so flattening both kinds together
-    would give each ``shard_axis`` rank a different mask over the replicated
-    sections and silently de-synchronise replicated parameters.  The tree is
-    split into the two groups (``is_sharded`` aligned with
-    ``jax.tree.leaves`` order): the replicated group's inputs — already
-    psum'd over ``shard_axis`` by shard_map AD — are identical on every
-    rank, so its masks agree; the sharded group syncs each shard
-    independently over ``sync_axes``.  Comm stats report model-wide totals
-    (the sharded group's per-rank stats psum over ``shard_axis``).
+    Compression masks are data-dependent, so flattening leaves with
+    DIFFERENT replication signatures together would give ranks that share
+    one leaf but not another different masks over the shared sections and
+    silently de-synchronise replicated parameters.  ``leaf_axes`` — aligned
+    with ``jax.tree.leaves`` order — gives each leaf the tuple of model
+    axes it is sharded over (``()`` = fully replicated); leaves sync in one
+    group PER SIGNATURE: within a group every rank pair that shares the
+    group's data either shares all of it (identical inputs -> identical
+    masks) or none (independent shards).  Comm stats report model-wide
+    totals: each group's per-rank stats psum over exactly its signature's
+    axes.
     """
     base_sync = make_grad_sync(cfg, axis_name=sync_axes)
-    is_sharded = list(is_sharded)
+    leaf_axes = [tuple(a) for a in leaf_axes]
+    sigs = sorted(set(leaf_axes))  # deterministic group order
+    sig_of = {s: i for i, s in enumerate(sigs)}
+    group_of = [sig_of[a] for a in leaf_axes]
 
     def split(tree):
         leaves = jax.tree.leaves(tree)
-        return (
-            [l for l, s in zip(leaves, is_sharded) if not s],
-            [l for l, s in zip(leaves, is_sharded) if s],
-        )
+        return [[l for l, g in zip(leaves, group_of) if g == gi]
+                for gi in range(len(sigs))]
 
-    def merge(like, rep, sh):
-        rep_it, sh_it = iter(rep), iter(sh)
-        leaves = [next(sh_it) if s else next(rep_it) for s in is_sharded]
+    def merge(like, groups):
+        its = [iter(g) for g in groups]
+        leaves = [next(its[g]) for g in group_of]
         return jax.tree.unflatten(jax.tree.structure(like), leaves)
 
     def sync(grads, ef, key):
         use_ef = cfg.error_feedback
-        g_rep, g_sh = split(grads)
-        e_rep, e_sh = split(ef) if use_ef else ((), ())
-        key_rep, key_sh = jax.random.split(key)
-        sync_rep, ef_rep, comm_rep = base_sync(g_rep, e_rep if use_ef else (), key_rep)
-        sync_sh, ef_sh, comm_sh = base_sync(g_sh, e_sh if use_ef else (), key_sh)
-        synced = merge(grads, sync_rep, sync_sh)
-        new_ef = merge(ef, ef_rep, ef_sh) if use_ef else ()
-        comm = {
-            k: comm_rep[k] + jax.lax.psum(comm_sh[k], shard_axis)
-            for k in comm_rep
-        }
+        g_groups = split(grads)
+        e_groups = split(ef) if use_ef else [() for _ in sigs]
+        keys = jax.random.split(key, len(sigs))
+        out_g, out_e, comm = [], [], None
+        for gi, sig in enumerate(sigs):
+            s_g, s_e, s_comm = base_sync(
+                g_groups[gi], e_groups[gi] if use_ef else (), keys[gi])
+            out_g.append(s_g)
+            out_e.append(s_e)
+            if sig:
+                s_comm = {k: jax.lax.psum(v, sig) for k, v in s_comm.items()}
+            comm = s_comm if comm is None else {
+                k: comm.get(k, 0.0) + s_comm.get(k, 0.0)
+                for k in set(comm) | set(s_comm)
+            }
+        synced = merge(grads, out_g)
+        new_ef = merge(ef, out_e) if use_ef else ()
         return synced, new_ef, comm
 
     return sync
+
+
+def make_grouped_grad_sync(cfg: CompressionConfig, sync_axes, is_sharded,
+                           shard_axis):
+    """Binary convenience wrapper over :func:`make_partitioned_grad_sync`:
+    leaves are either replicated or sharded over ``shard_axis`` (a name or
+    tuple of names)."""
+    axes = (shard_axis,) if isinstance(shard_axis, str) else tuple(shard_axis)
+    leaf_axes = [axes if s else () for s in is_sharded]
+    return make_partitioned_grad_sync(cfg, sync_axes, leaf_axes)

@@ -22,10 +22,14 @@ microbatch pipelining of the transformer stack, TPU-native formulation —
     psum'd over ``pipe`` by shard_map AD before the compressed data-axis
     sync sees them.
 
-Composability note: this step owns the (data, pipe) composition; sequence
-and tensor axes live in :mod:`tpu_compressed_dp.train.lm_step`.  Combining
-all five axes in one step is future work — the reference had exactly one
-axis (SURVEY.md §2.2), so every composition here is net-new capability.
+Composability note: this step owns the (data, pipe[, tensor]) composition —
+pass ``make_pp_mesh(data, pipe, tensor)`` with ``tensor > 1`` for megatron
+sharding inside each stage (column-parallel qkv/gate/up, row-parallel
+wo/w_down, vocab-parallel head/loss, expert-parallel MoE).  The sequence
+axis lives in :mod:`tpu_compressed_dp.train.lm_step` (data, seq, tensor);
+a single step combining all four model axes is future work — the reference
+had exactly one axis (SURVEY.md §2.2), so every composition here is
+net-new capability.
 """
 
 from __future__ import annotations
@@ -50,8 +54,8 @@ from tpu_compressed_dp.models.transformer import (
 from tpu_compressed_dp.ops.ring_attention import ring_attention
 from tpu_compressed_dp.parallel.dp import (
     CompressionConfig,
-    make_grouped_grad_sync,
-    make_sharded_clip,
+    make_partitioned_clip,
+    make_partitioned_grad_sync,
 )
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
@@ -70,12 +74,16 @@ def place_pp_state(state: TrainState, cfg: "LlamaConfig",
     device, and the pipelined step needs layer stacks sharded over ``pipe``
     and EF residuals over ``data`` (`train_imagenet_nv.py:193-198` is the
     reference's resume)."""
-    return state.place_with_specs(pp_state_specs(cfg, comp), mesh)
+    return state.place_with_specs(
+        pp_state_specs(cfg, comp, tensor=mesh.shape.get("tensor", 1) > 1),
+        mesh)
 
 
-def make_pp_mesh(data: int, pipe: int) -> Mesh:
+def make_pp_mesh(data: int, pipe: int, tensor: int = 1) -> Mesh:
     from tpu_compressed_dp.parallel.mesh import make_mesh
 
+    if tensor > 1:
+        return make_mesh((data, pipe, tensor), ("data", "pipe", "tensor"))
     return make_mesh((data, pipe), ("data", "pipe"))
 
 
@@ -105,13 +113,38 @@ def init_pp_ef_state(cfg: LlamaConfig, stacked_params: Dict[str, Any],
     )
 
 
-def pp_state_specs(cfg: LlamaConfig, comp: CompressionConfig) -> TrainState:
-    layer_specs = {k: P("pipe") for k in (
-        ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
-         "w_gate", "w_up", "w_down"] + (["router"] if cfg.n_experts else [])
-    )}
-    pspecs = {"embed": P(), "final_norm": P(), "lm_head": P(),
-              "layers": layer_specs}
+def pp_state_specs(cfg: LlamaConfig, comp: CompressionConfig,
+                   tensor: bool = False) -> TrainState:
+    """Specs for the stacked-layer state; with ``tensor`` the megatron
+    sharding of :func:`transformer.param_specs` composes onto the stacked
+    arrays (layer dim over ``pipe``, weight dims over ``tensor``)."""
+    if not tensor:
+        layer_specs = {k: P("pipe") for k in (
+            ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+             "w_gate", "w_up", "w_down"] + (["router"] if cfg.n_experts else [])
+        )}
+        pspecs = {"embed": P(), "final_norm": P(), "lm_head": P(),
+                  "layers": layer_specs}
+    else:
+        t = "tensor"
+        if cfg.n_experts:
+            # stacked expert weights: [L, e, ...] — experts over tensor,
+            # mirroring param_specs' expert-parallel layout
+            ffn = {"router": P("pipe"),
+                   "w_gate": P("pipe", t), "w_up": P("pipe", t),
+                   "w_down": P("pipe", t)}
+        else:
+            # column-parallel gate/up, row-parallel down ([L, in, out])
+            ffn = {"w_gate": P("pipe", None, t), "w_up": P("pipe", None, t),
+                   "w_down": P("pipe", t, None)}
+        layer_specs = {
+            "attn_norm": P("pipe"), "mlp_norm": P("pipe"),
+            "wq": P("pipe", None, t), "wk": P("pipe", None, t),
+            "wv": P("pipe", None, t), "wo": P("pipe", t, None),
+            **ffn,
+        }
+        pspecs = {"embed": P(), "final_norm": P(),
+                  "lm_head": P(None, t), "layers": layer_specs}
     ef_specs = jax.tree.map(lambda s: P("data", *s), pspecs,
                             is_leaf=lambda x: isinstance(x, P))
     return TrainState(
@@ -123,9 +156,12 @@ def pp_state_specs(cfg: LlamaConfig, comp: CompressionConfig) -> TrainState:
 
 
 def _decoder_layer(cfg: LlamaConfig, lp: Dict[str, Array], h: Array,
-                   pos: Array) -> Array:
+                   pos: Array, tensor_axis=None) -> Array:
     """One pre-norm decoder layer from unstacked per-layer params (the
-    single-device body of apply_llama, factored for reuse by the stages)."""
+    single-device body of apply_llama, factored for reuse by the stages).
+    With ``tensor_axis``, qkv/gate/up are column-sharded and wo/w_down
+    row-sharded — the same megatron layout as apply_llama, composed with
+    the pipe stacking."""
     dt = cfg.dtype
     hd = cfg.head_dim
     x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
@@ -135,13 +171,16 @@ def _decoder_layer(cfg: LlamaConfig, lp: Dict[str, Array], h: Array,
     v = (x @ lp["wv"].astype(dt)).reshape(b, t, -1, hd).transpose(0, 2, 1, 3)
     q, k = _rope(q, pos, cfg.rope_theta), _rope(k, pos, cfg.rope_theta)
     o = ring_attention(q, k, v, axis_name=None)
-    h = h + (o.transpose(0, 2, 1, 3).reshape(b, t, -1) @ lp["wo"].astype(dt))
+    attn = o.transpose(0, 2, 1, 3).reshape(b, t, -1) @ lp["wo"].astype(dt)
+    h = h + _psum_if(attn, tensor_axis)
     x = _rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts:
-        mlp, _ = _moe_ffn(cfg, lp, x, None)
+        mlp, _ = _moe_ffn(cfg, lp, x, tensor_axis)
     else:
-        mlp = (jax.nn.silu(x @ lp["w_gate"].astype(dt))
-               * (x @ lp["w_up"].astype(dt))) @ lp["w_down"].astype(dt)
+        mlp = _psum_if(
+            (jax.nn.silu(x @ lp["w_gate"].astype(dt))
+             * (x @ lp["w_up"].astype(dt))) @ lp["w_down"].astype(dt),
+            tensor_axis)
     return h + mlp
 
 
@@ -168,6 +207,10 @@ def make_pp_train_step(
     over ``pipe``, replicated embed/head/norm leaves count once.
     """
     stages = mesh.shape["pipe"]
+    tp = mesh.shape.get("tensor", 1)
+    tensor_axis = "tensor" if tp > 1 else None
+    if tp > 1:
+        cfg.validate_mesh(tp)
     if cfg.n_layers % stages:
         raise ValueError(f"n_layers ({cfg.n_layers}) must divide by pipe "
                          f"size {stages}")
@@ -184,14 +227,20 @@ def make_pp_train_step(
             "deferred LM head falls back to every stage heading the full "
             "drained batch — correct, but S x the logits memory and head "
             "FLOPs of the even-split fast path", stacklevel=2)
-    # pipe-sharded layer stacks vs pipe-replicated embed/head/norm sync as
-    # separate groups (see make_grouped_grad_sync)
-    spec_tree = pp_state_specs(cfg, comp_cfg).params
+    # Leaves sync in one group per model-axis replication signature — four
+    # at pipe x tensor: fully replicated (embed/final_norm), pipe-sharded
+    # tensor-replicated (norm vectors), tensor-sharded pipe-replicated
+    # (lm_head), pipe+tensor-sharded (layer weights).  Mixing signatures
+    # under one data-dependent compression mask would de-synchronise
+    # replicas (see make_partitioned_grad_sync).
+    spec_tree = pp_state_specs(cfg, comp_cfg, tensor=tp > 1).params
     spec_leaves = jax.tree.leaves(spec_tree, is_leaf=lambda x: isinstance(x, P))
-    is_sharded = [any(ax == "pipe" for ax in spec) for spec in spec_leaves]
-    grad_sync = make_grouped_grad_sync(comp_cfg, ("data",), is_sharded, "pipe")
-
-    clip_tree = make_sharded_clip(is_sharded, "pipe")
+    model_axes = ("pipe", "tensor") if tp > 1 else ("pipe",)
+    leaf_axes = [tuple(a for a in model_axes
+                       if any(ax == a for ax in spec))
+                 for spec in spec_leaves]
+    grad_sync = make_partitioned_grad_sync(comp_cfg, ("data",), leaf_axes)
+    clip_tree = make_partitioned_clip(leaf_axes)
     n_workers = mesh.shape["data"]
     dt = cfg.dtype
 
@@ -209,7 +258,7 @@ def make_pp_train_step(
             def stage_apply(h):
                 for i in range(layers_per_stage):
                     lp = jax.tree.map(lambda a: a[i], params["layers"])
-                    h = _decoder_layer(cfg, lp, h, pos)
+                    h = _decoder_layer(cfg, lp, h, pos, tensor_axis)
                 return h
 
             def tick(h_cur, t):
@@ -253,8 +302,9 @@ def make_pp_train_step(
                 my_y = jax.lax.pcast(ys, ("pipe",), to="varying")
             hn = _rms_norm(my_h.reshape(m_s * mb, t_len, cfg.dim),
                            params["final_norm"], cfg.norm_eps)
-            logits = hn @ params["lm_head"].astype(dt)
-            nll = vocab_parallel_xent(logits, my_y.reshape(m_s * mb, t_len))
+            logits = hn @ params["lm_head"].astype(dt)  # [., T, V/tp]
+            nll = vocab_parallel_xent(logits, my_y.reshape(m_s * mb, t_len),
+                                      tensor_axis=tensor_axis)
             # equal chunks: mean of chunk-means == global mean
             loss = jax.lax.psum(nll * scale, "pipe")
             return loss
@@ -288,7 +338,7 @@ def make_pp_train_step(
             ef=new_ef,
         ), metrics
 
-    state_spec = pp_state_specs(cfg, comp_cfg)
+    state_spec = pp_state_specs(cfg, comp_cfg, tensor=tp > 1)
     sharded = shard_map(
         local_step,
         mesh=mesh,

@@ -114,3 +114,24 @@ def mfu(model_flops_per_sec: float, device=None) -> Optional[float]:
     if not peak or model_flops_per_sec <= 0:
         return None
     return model_flops_per_sec / peak
+
+
+def cnn_mfu_record(apply_fn, params, batch_stats, input_shape,
+                   steps_per_sec: float) -> Dict[str, float]:
+    """The benchmark-record MFU fields for a CNN-style ``apply_fn`` (the
+    shared epilogue of bench.py and bench/sweep.py): forward FLOPs from the
+    XLA cost model at the given per-chip input shape, train = 3x fwd at the
+    measured step rate, ``mfu`` vs the chip's bf16 peak.  Empty dict where
+    the backend exposes no cost model; ``mfu`` omitted off-TPU."""
+    fwd = fwd_flops_xla(
+        lambda p, s, x: apply_fn(p, s, x, True, {}),
+        params, batch_stats,
+        jnp.zeros(input_shape, jnp.float32))
+    if fwd is None:
+        return {}
+    per_chip = train_flops_per_step(fwd) * steps_per_sec
+    rec = {"model_tflops_per_sec_per_chip": round(per_chip / 1e12, 3)}
+    u = mfu(per_chip)
+    if u is not None:
+        rec["mfu"] = round(u, 4)
+    return rec
