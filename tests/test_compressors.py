@@ -12,6 +12,9 @@ import pytest
 
 from tpu_compressed_dp.ops import compressors as C
 
+pytestmark = pytest.mark.quick  # fast tier (VERDICT r2 #10)
+
+
 
 def rand_grad(n=1000, seed=0):
     return jax.random.normal(jax.random.key(seed), (n,), jnp.float32)

@@ -3,6 +3,10 @@
 import numpy as np
 
 from tpu_compressed_dp.data import cifar10 as D
+import pytest
+
+pytestmark = pytest.mark.quick  # fast tier (VERDICT r2 #10)
+
 
 
 def test_normalise_matches_reference_formula():

@@ -5,6 +5,10 @@ remote tensorboard -> SSH port-forward).  Print-mode only: CI has no gcloud."""
 import os
 import subprocess
 import sys
+import pytest
+
+pytestmark = pytest.mark.quick  # fast tier (VERDICT r2 #10)
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOL = os.path.join(REPO, "tools", "dataset_tools.py")

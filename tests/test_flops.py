@@ -4,6 +4,10 @@ import jax
 import jax.numpy as jnp
 
 from tpu_compressed_dp.utils import flops as F
+import pytest
+
+pytestmark = pytest.mark.quick  # fast tier (VERDICT r2 #10)
+
 
 
 class _FakeDev:

@@ -9,6 +9,9 @@ import jax.numpy as jnp
 from tpu_compressed_dp.models import graph as G
 from tpu_compressed_dp.models.common import init_model, make_apply_fn
 
+pytestmark = pytest.mark.quick  # fast tier (VERDICT r2 #10)
+
+
 
 class TestWiring:
     def test_default_sequential(self):

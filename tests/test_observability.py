@@ -10,6 +10,10 @@ from tpu_compressed_dp.utils import meters
 from tpu_compressed_dp.utils.loggers import FileLogger, NoOp, TensorboardLogger
 
 
+
+
+
+@pytest.mark.quick
 class TestTensorboardLogger:
     def test_writes_scalars_and_json(self, tmp_path):
         tb = TensorboardLogger(str(tmp_path / "tb"))
@@ -35,6 +39,7 @@ class TestTensorboardLogger:
         assert isinstance(TensorboardLogger(None), NoOp)
 
 
+@pytest.mark.quick
 class TestFileLogger:
     def test_level_routing(self, tmp_path, capsys):
         log = FileLogger(str(tmp_path), rank=3)
@@ -54,6 +59,7 @@ class TestFileLogger:
         assert not os.listdir(tmp_path)
 
 
+@pytest.mark.quick
 class TestMeters:
     def test_network_bytes_reads_proc(self):
         recv, transmit = meters.network_bytes()

@@ -9,6 +9,9 @@ import pytest
 
 from tpu_compressed_dp.utils import resilience
 
+pytestmark = pytest.mark.quick  # fast tier (VERDICT r2 #10)
+
+
 
 class TestHeartbeat:
     def test_write_read_stale(self, tmp_path):
