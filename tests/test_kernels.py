@@ -248,3 +248,85 @@ def test_topk_threshold_jnp_fallback_guarantee():
         # threshold within the refinement resolution of the exact k-th value
         assert float(t) <= exact
         assert cnt <= keep + max(8, int(0.01 * n))
+
+
+class TestPackByThreshold:
+    """Fused wire-pack kernel (VERDICT r2 #4): correct but slower than the
+    unfused chain on this chip — kept in-tree as a measured negative result
+    (benchmarks/pack_kernel_r3.txt), NOT dispatched by the wire path."""
+
+    def _check(self, n, keep, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_compressed_dp.ops import kernels as K
+
+        rng = np.random.default_rng(seed)
+        acc = jnp.asarray(rng.standard_normal(n), jnp.float32)
+        t = jnp.asarray(
+            np.partition(np.abs(np.asarray(acc)), n - keep)[n - keep],
+            jnp.float32)
+        vals, idx, ef, count = K.pack_by_threshold(
+            acc, t, keep, want_ef=True, interpret=True)
+        mask = np.asarray(jnp.abs(acc) >= t)
+        a = np.asarray(acc)
+        dense = np.zeros(n, np.float64)
+        np.add.at(dense, np.asarray(idx), np.asarray(vals, np.float64))
+        np.testing.assert_allclose(dense, np.where(mask, a, 0.0),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(ef), np.where(mask, 0.0, a))
+        assert int(count) == mask.sum()
+        nz = np.asarray(vals) != 0
+        assert nz.sum() == mask.sum()
+        assert np.all(np.diff(np.asarray(idx)[nz]) > 0)  # ascending payload
+
+    def test_small_pack(self, monkeypatch):
+        from tpu_compressed_dp.ops import kernels as K
+
+        monkeypatch.setattr(K, "_PACK_ROWS", 16)  # interpreter-tractable
+        self._check(5000, 50)
+
+    def test_multiblock_and_ragged(self, monkeypatch):
+        from tpu_compressed_dp.ops import kernels as K
+
+        monkeypatch.setattr(K, "_PACK_ROWS", 16)
+        self._check(17000, 700)   # multi-block + ragged tail
+        self._check(40000, 350)
+
+    def test_payload_slots_accounting(self):
+        from tpu_compressed_dp.ops import kernels as K
+
+        P = K.pack_payload_slots(5_000_000, 50_000)
+        blocks = -(-5_000_000 // (K._PACK_ROWS * 128))
+        assert P == -(-50_000 // 128) * 128 + blocks * 128
+
+    def test_capacity_truncation_conserves_mass(self, monkeypatch):
+        """Overflow regime (survivors >> capacity): payload + residual must
+        still reconstruct acc exactly — truncated blocks keep ALL their
+        survivors in the residual, the payload carries no garbage, and
+        `count` reports what actually shipped (review r3 findings)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_compressed_dp.ops import kernels as K
+
+        monkeypatch.setattr(K, "_PACK_ROWS", 16)
+        rng = np.random.default_rng(3)
+        n, keep = 8192, 128
+        acc = jnp.asarray(rng.standard_normal(n), jnp.float32)
+        t = jnp.asarray(0.01, jnp.float32)  # ~99% survive: massive overflow
+        vals, idx, ef, count = K.pack_by_threshold(
+            acc, t, keep, want_ef=True, interpret=True)
+        a = np.asarray(acc)
+        dense = np.zeros(n, np.float64)
+        np.add.at(dense, np.asarray(idx), np.asarray(vals, np.float64))
+        # payload + residual == acc for surviving coords; residual == acc
+        # for non-survivors; nothing lost, nothing duplicated
+        np.testing.assert_allclose(dense + np.asarray(ef, np.float64), a,
+                                   rtol=1e-6, atol=1e-7)
+        nz = np.asarray(vals) != 0
+        assert int(count) == nz.sum()          # count == shipped survivors
+        assert nz.sum() < np.count_nonzero(np.abs(a) >= 0.01)  # truncated
+        assert np.all(np.asarray(idx) < n)     # no uninitialised garbage
