@@ -64,8 +64,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1, help="sequence axis size")
     p.add_argument("--tp", type=int, default=1, help="tensor axis size")
     p.add_argument("--pp", type=int, default=1,
-                   help="pipeline stages (uses the (data, pipe) step; "
-                        "requires --sp 1 --tp 1)")
+                   help="pipeline stages (the (data, seq, pipe, tensor) "
+                        "step; composes with --sp and --tp)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="pipeline microbatches per step (--pp > 1 only)")
     # data/schedule
@@ -134,15 +134,11 @@ def run(args) -> Dict[str, float]:
     distributed_init(args.coordinator, args.num_processes, args.process_id)
     ndev = len(jax.devices())
     pipelined = args.pp > 1
-    if pipelined and args.sp != 1:
-        raise ValueError("--pp composes with --dp and --tp (set --sp 1); "
-                         "sequence sharding lives in the (data, seq, tensor) "
-                         "step")
     dp = args.dp if args.dp is not None else ndev // (args.sp * args.tp * args.pp)
     if pipelined:
         from tpu_compressed_dp.train.pp_step import make_pp_mesh
 
-        mesh = make_pp_mesh(dp, args.pp, args.tp)
+        mesh = make_pp_mesh(dp, args.pp, args.tp, args.sp)
     else:
         mesh = make_lm_mesh(dp, args.sp, args.tp)
     cfg = build_config(args)
@@ -223,7 +219,7 @@ def run(args) -> Dict[str, float]:
         train_step = make_lm_train_step(cfg, opt, comp, mesh,
                                         clip_norm=args.clip_norm,
                                         clip_sent_norm=args.clip_sent_norm)
-    mesh_str = (f"dp{dp}xpp{args.pp}xtp{args.tp}(mb{args.microbatches})" if pipelined
+    mesh_str = (f"dp{dp}xsp{args.sp}xpp{args.pp}xtp{args.tp}(mb{args.microbatches})" if pipelined
                 else f"dp{dp}xsp{args.sp}xtp{args.tp}")
     print(f"params={n_params/1e6:.1f}M mesh={mesh_str} "
           f"seq={args.seq_len} batch={args.global_batch} "
