@@ -138,6 +138,25 @@ class TestQuantizerWire:
         with pytest.raises(ValueError, match="unbiased"):
             run_sync(mesh8, cfg, make_grads())
 
+    def test_terngrad_chunked_wire_matches_simulate(self, mesh8):
+        # chunked scales (the entire-model NaN fix) through the WIRE path:
+        # per-chunk fp32 scales travel with the int8 levels and the combined
+        # result equals simulate mode with the same chunking
+        grads = make_grads()
+        sim = CompressionConfig(method="terngrad", mode="simulate",
+                                granularity="entiremodel", shared_mask=True,
+                                terngrad_chunk=16)
+        wire = CompressionConfig(method="terngrad", mode="wire",
+                                 granularity="entiremodel", shared_mask=True,
+                                 terngrad_chunk=16)
+        out_s, _, _ = run_sync(mesh8, sim, grads)
+        out_w, _, stats = run_sync(mesh8, wire, grads)
+        for leaf in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(out_s[leaf]), np.asarray(out_w[leaf]),
+                rtol=1e-5, atol=1e-6)
+        assert float(stats["sent_bits_allgather"]) > 0.0
+
 
 class TestThresholdWire:
     """Fixed-capacity wire Threshold-V / Adaptive-Threshold (6/6 wire
