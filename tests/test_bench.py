@@ -79,4 +79,8 @@ def test_run_sweep_cli(mesh8, tmp_path, capsys):
     # dense baseline + one terngrad point
     assert [r["method"] for r in records] == ["none", "terngrad"]
     assert records[1]["wire_frac"] < 0.1  # 2-bit levels
-    assert (tmp_path / "s.tsv").read_text().count("\n") == 3
+    lines = (tmp_path / "s.tsv").read_text().splitlines()
+    comments = [ln for ln in lines if ln.startswith("#")]
+    assert comments, "TSV should carry the counterfactual-column caveat header"
+    assert any("COUNTERFACTUAL" in ln for ln in comments)
+    assert len(lines) - len(comments) == 3  # header + dense + terngrad

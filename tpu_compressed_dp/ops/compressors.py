@@ -381,20 +381,29 @@ def payload_bits_per_elem(
     """Analytic wire width of one transmitted element, in bits.
 
     This is the accounting the reference measured empirically from
-    /proc/net/dev (`meter.py:24-47`); on TPU the payload layout is known:
+    /proc/net/dev (`meter.py:24-47`); on TPU the payload layout is known —
+    and, as of round 4, every width below is the layout the wire engine
+    *actually transports* (``ops.wire`` bit-packs the quantizers and bills
+    measured payload bytes; ``tests/test_wire.py::TestMeasuredTransport``
+    asserts the wire bill equals the collective's bytes).  This analytic
+    model amortises the fp32 scales and the pad-to-4/pad-to-8 packing slack
+    away, so wire-mode ``sent_bits`` runs a hair above ``n × width`` (e.g.
+    ~2.02 vs 2.0 bits/elem for TernGrad at small leaves):
       * dense fp32 value: 32;
       * sparsifier: 32-bit value + 32-bit index, except shared-seed Random-K
         whose indices are implied by the common PRNG key
         (`sparsified_ddp.py:164` — only k values travel, `:412`);
       * Block-Top-K: 32-bit value + one 32-bit block index per block_size
         elements;
-      * TernGrad: 2 bits per element (3 levels) + one fp32 scale (amortised);
-      * QSGD/random dithering: sign + ceil(log2(qstates+1)) level bits + one
-        fp32 norm (amortised) — the QSGD paper's variable-length bound is
-        tighter but this is the fixed-width layout a TPU kernel would pack.
+      * TernGrad: 2 bits per element — four ternary codes bit-packed per
+        byte (:func:`ops.wire.pack_ternary`) + fp32 scale(s) (amortised);
+      * QSGD/random dithering: narrowest fixed-width layout that fits
+        ``qstates`` (:func:`ops.wire.qsgd_wire_pack`): int8 sign⊗level for
+        ``qstates <= 127`` (8), uint8 magnitude + 1 packed sign bit for
+        ``qstates <= 255`` (9), int16 beyond (16); + one fp32 norm
+        (amortised).  The QSGD paper's variable-length bound is tighter but
+        these are the fixed-width layouts the TPU collective moves.
     """
-    import math
-
     if name in ("none", "thresholdv", "adaptive_threshold", "topk"):
         return 32.0 if name == "none" else 64.0
     if name == "randomk":
@@ -404,7 +413,7 @@ def payload_bits_per_elem(
     if name == "terngrad":
         return 2.0
     if name == "qsgd":
-        return 1.0 + math.ceil(math.log2(qstates + 1))
+        return 8.0 if qstates <= 127 else (9.0 if qstates <= 255 else 16.0)
     raise ValueError(f"unknown compressor {name!r}")
 
 

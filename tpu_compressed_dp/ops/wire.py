@@ -28,13 +28,15 @@ covering all six reference operators plus the net-new Block-Top-K:
     ~n/block_size block *scores*, and the payload — ``[kb, block_size]``
     value rows + ``[kb]`` block indices — gathers/scatters as contiguous
     lane-aligned rows.  The TPU-native fast path among the sparsifiers.
-  * **TernGrad**: per-worker ternary levels packed to int8 (wire width 8 bits;
-    the information content is the 2 bits/elem the analytic accounting
-    reports) plus one fp32 scale, combined via ``all_gather``.
-  * **QSGD / random dithering**: per-worker quantisation levels packed to
-    int16 (sign ⊗ level, level ≤ qstates) plus one fp32 norm, combined via
-    ``all_gather``.
-
+  * **TernGrad**: per-worker ternary levels bit-packed four-per-byte
+    (codes ``level+1 ∈ {0,1,2}`` → 2 bits each) plus the fp32 scale(s),
+    combined via ``all_gather`` — the collective moves the 2 bits/elem the
+    analytic accounting bills (round 4; previously int8 shipped while 2 bits
+    were billed, a 4× understatement).
+  * **QSGD / random dithering**: narrowest layout that fits ``qstates``:
+    ``sign ⊗ level`` int8 for ``qstates ≤ 127`` (8 bits/elem), uint8
+    magnitudes + a bit-packed sign bitmap for ``qstates ≤ 255`` (9 bits/elem),
+    int16 beyond; plus one fp32 norm, combined via ``all_gather``.
   * **Threshold-V / Adaptive-Threshold** (`core.py:189-199`): survivor
     counts are data-dependent — hostile to XLA's static shapes — so the wire
     form is a **fixed-capacity buffer**: each worker packs its first
@@ -48,6 +50,11 @@ covering all six reference operators plus the net-new Block-Top-K:
     cap-sized buffer, and the analytic accounting bills it as such
     (``sent_bits = cap * 64`` even when half-empty — fixed-size transport
     is the honest wire cost).
+
+All wire methods bill **measured transport**: ``sent_bits`` is computed from
+the actual byte sizes of the arrays handed to the collective (including
+scales/norms), the TPU-static analog of the reference's NIC byte meter
+(`IMAGENET/training/meter.py:24-47,66-86`).
 
 Error feedback composes with the sparsifiers exactly as in
 `sparsified_ddp.py:408-413`: the residual (dropped coordinates) is returned
@@ -66,7 +73,9 @@ from tpu_compressed_dp.ops import compressors
 
 Array = jax.Array
 
-__all__ = ["make_wire_grad_sync", "WIRE_METHODS"]
+__all__ = ["make_wire_grad_sync", "WIRE_METHODS", "pack_ternary",
+           "unpack_ternary", "pack_bits", "unpack_bits", "qsgd_wire_pack",
+           "qsgd_wire_unpack"]
 
 WIRE_METHODS = ("randomk", "topk", "blocktopk", "terngrad", "qsgd",
                 "thresholdv", "adaptive_threshold")
@@ -79,6 +88,75 @@ try:
     from jax._src.lax.parallel import all_gather_invariant as _all_gather
 except ImportError:  # pragma: no cover - older/newer jax layouts
     _all_gather = jax.lax.all_gather
+
+
+def pack_ternary(levels: Array) -> Array:
+    """Bit-pack ternary levels (int8 in {-1,0,1}) four-per-byte.
+
+    Codes ``level+1 ∈ {0,1,2}`` occupy 2 bits; byte layout is little-endian
+    within the byte (element i sits at bits ``2*(i%4)``).  Output is
+    ``uint8[ceil(n/4)]`` — the actual wire form TernGrad's all_gather moves.
+    Arithmetic runs in int32 (TPU-native lane width); only the final cast is
+    uint8, so no sub-word shift ops are required of Mosaic/XLA.
+    """
+    n = levels.shape[0]
+    pad = (-n) % 4
+    c = jnp.pad(levels, (0, pad)).astype(jnp.int32) + 1  # {0,1,2}
+    c = c.reshape(-1, 4)
+    packed = c[:, 0] + (c[:, 1] << 2) + (c[:, 2] << 4) + (c[:, 3] << 6)
+    return packed.astype(jnp.uint8)
+
+
+def unpack_ternary(packed: Array, n: int) -> Array:
+    """Inverse of :func:`pack_ternary`: ``uint8[ceil(n/4)] -> int8[n]``."""
+    p = packed.astype(jnp.int32)
+    codes = jnp.stack(
+        [p & 3, (p >> 2) & 3, (p >> 4) & 3, (p >> 6) & 3], axis=-1)
+    return (codes.reshape(*packed.shape[:-1], -1)[..., :n] - 1).astype(jnp.int8)
+
+
+def pack_bits(bits: Array) -> Array:
+    """Pack a boolean vector eight-per-byte (little-endian within the byte)."""
+    n = bits.shape[0]
+    pad = (-n) % 8
+    b = jnp.pad(bits, (0, pad)).astype(jnp.int32).reshape(-1, 8)
+    w = (1 << jnp.arange(8, dtype=jnp.int32))
+    return jnp.sum(b * w, axis=1).astype(jnp.uint8)
+
+
+def unpack_bits(packed: Array, n: int) -> Array:
+    """Inverse of :func:`pack_bits`: ``uint8[ceil(n/8)] -> bool[n]``."""
+    p = packed.astype(jnp.int32)
+    bits = jnp.stack([(p >> i) & 1 for i in range(8)], axis=-1)
+    return bits.reshape(*packed.shape[:-1], -1)[..., :n].astype(bool)
+
+
+def qsgd_wire_pack(levels: Array, qstates: int) -> tuple[Array, ...]:
+    """Narrowest wire layout for QSGD ``sign ⊗ level`` int16 levels.
+
+    * ``qstates <= 127``: one int8 array (sign and magnitude share the byte);
+    * ``qstates <= 255``: uint8 magnitudes + a bit-packed sign bitmap
+      (9 bits/elem — the fixed-width layout `payload_bits_per_elem` bills);
+    * beyond: the int16 levels unchanged (16 bits/elem).
+    """
+    if qstates <= 127:
+        return (levels.astype(jnp.int8),)
+    if qstates <= 255:
+        mags = jnp.abs(levels.astype(jnp.int32)).astype(jnp.uint8)
+        signs = pack_bits(levels < 0)
+        return (mags, signs)
+    return (levels,)
+
+
+def qsgd_wire_unpack(payload: tuple[Array, ...], n: int, qstates: int,
+                     dtype=jnp.float32) -> Array:
+    """Inverse of :func:`qsgd_wire_pack`, returning ``sign ⊗ level`` in
+    ``dtype`` (ready to scale); accepts a leading gather axis."""
+    if qstates <= 127 or qstates > 255:
+        return payload[0].astype(dtype)
+    mags, signs = payload
+    neg = unpack_bits(signs, n)
+    return jnp.where(neg, -mags.astype(dtype), mags.astype(dtype))
 
 
 def packed_indices_from_mask(mask: Array, keep: int) -> Array:
@@ -136,6 +214,7 @@ def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world
                        check: bool = False):
     idx = _randomk_indices(key, flat.shape[0], keep)
     payload = flat[idx]                                   # [k] — all that travels
+    bits = _payload_bits(payload)
     reduced = jax.lax.psum(payload, axis_name) / world
     # NB: fresh zeros, not zeros_like(flat) — the latter would inherit the
     # device-varying manifest-axes tag of the local gradient and defeat
@@ -149,7 +228,7 @@ def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world
                                else jnp.float32) * (1.0 + jnp.arange(keep) % 7))
         agree = (jax.lax.pmax(h, axis_name) == jax.lax.pmin(h, axis_name)
                  ).astype(jnp.float32)
-    return dense, idx, agree
+    return dense, idx, agree, bits
 
 
 def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world,
@@ -166,6 +245,7 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world,
     mask = mag >= t
     idx = packed_indices_from_mask(mask, keep)
     payload = flat[idx]                                   # [k] values + [k] indices travel
+    bits = _payload_bits(payload, idx)
     g_vals = _all_gather(payload, axis_name)       # [W, k]
     g_idx = _all_gather(idx, axis_name)            # [W, k]
     dense = (
@@ -179,7 +259,7 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world,
     # dropped — surface the count so callers can see it (ADVICE r2)
     surplus = (jnp.maximum(jnp.sum(mask, dtype=jnp.int32) - keep, 0)
                if want_surplus else None)
-    return dense, idx, surplus
+    return dense, idx, surplus, bits
 
 
 def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
@@ -201,6 +281,7 @@ def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
     t = kernels.topk_threshold(scores, keep_blocks)
     bidx = packed_indices_from_mask(scores >= t, keep_blocks)
     payload = g2[bidx]                         # [kb, bs] contiguous rows
+    bits = _payload_bits(payload, bidx)
     g_vals = _all_gather(payload, axis_name)   # [W, kb, bs]
     g_idx = _all_gather(bidx, axis_name)       # [W, kb]
     dense2 = (
@@ -211,7 +292,7 @@ def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
     )
     dense = dense2.reshape(-1)[:n]
     new_ef = g2.at[bidx].set(0.0).reshape(-1)[:n] if want_ef else None
-    return dense, new_ef
+    return dense, new_ef, bits
 
 
 def _leaf_sync_threshold(flat: Array, v, cap: int, axis_name: str, world,
@@ -233,6 +314,7 @@ def _leaf_sync_threshold(flat: Array, v, cap: int, axis_name: str, world,
     valid = rank <= sent_count
     vals = jnp.where(valid, flat[idx], 0.0)
     idx = jnp.where(valid, idx, 0)
+    bits = _payload_bits(vals, idx)                  # the full cap-sized buffer
     g_vals = _all_gather(vals, axis_name)            # [W, cap]
     g_idx = _all_gather(idx, axis_name)              # [W, cap]
     dense = (
@@ -247,35 +329,48 @@ def _leaf_sync_threshold(flat: Array, v, cap: int, axis_name: str, world,
         # by 1 (scatter-mul identity)
         new_ef = flat.at[idx].mul(jnp.where(valid, 0.0, 1.0))
     overflow = jnp.maximum(count - cap, 0)
-    return dense, new_ef, sent_count, overflow
+    return dense, new_ef, sent_count, overflow, bits
+
+
+def _payload_bits(*arrays: Array) -> float:
+    """Measured transport: total bits of the arrays handed to the collective
+    (one worker's payload — the per-chip quantity the traffic model scales)."""
+    return float(sum(a.size * a.dtype.itemsize * 8 for a in arrays))
 
 
 def _leaf_sync_terngrad(flat: Array, key: Array, chunk: int, axis_name: str,
                         world):
+    n = flat.shape[0]
     levels, scale = compressors.terngrad_levels(flat, key, chunk=chunk)
-    g_levels = _all_gather(levels, axis_name)             # [W, n] int8
+    packed = pack_ternary(levels)                         # uint8[ceil(n/4)]
+    bits = _payload_bits(packed, scale)
+    g_packed = _all_gather(packed, axis_name)             # [W, ceil(n/4)]
     g_scale = _all_gather(scale, axis_name)               # [W] or [W, nc]
+    g_levels = unpack_ternary(g_packed, n)                # [W, n] int8
     if scale.ndim == 0:
         dense = jnp.sum(
             g_scale[:, None] * g_levels.astype(flat.dtype), axis=0) / world
-        return dense
+        return dense, bits
     # chunked scales: broadcast each worker's [nc] scales over its chunks
-    n = flat.shape[0]
     nc = scale.shape[0]
     pad = nc * chunk - n
     lv = jnp.pad(g_levels, ((0, 0), (0, pad))).reshape(-1, nc, chunk)
     dense = jnp.sum(
         g_scale[:, :, None] * lv.astype(flat.dtype), axis=0
     ).reshape(-1)[:n] / world
-    return dense
+    return dense, bits
 
 
 def _leaf_sync_qsgd(flat: Array, key: Array, qstates: int, axis_name: str, world):
+    n = flat.shape[0]
     levels, scale = compressors.qsgd_levels(flat, key, qstates=qstates)
-    g_levels = _all_gather(levels, axis_name)             # [W, n] int16
+    payload = qsgd_wire_pack(levels, qstates)
+    bits = _payload_bits(*payload, scale)
+    g_payload = tuple(_all_gather(p, axis_name) for p in payload)
     g_scale = _all_gather(scale, axis_name)               # [W]
-    dense = jnp.sum(g_scale[:, None] * g_levels.astype(flat.dtype), axis=0) / world
-    return dense
+    g_levels = qsgd_wire_unpack(g_payload, n, qstates, dtype=flat.dtype)
+    dense = jnp.sum(g_scale[:, None] * g_levels, axis=0) / world
+    return dense, bits
 
 
 def make_wire_grad_sync(cfg, axis_name: str = "data"):
@@ -305,10 +400,6 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             "terngrad/qsgd are unbiased quantizers with no dropped coordinates"
         )
 
-    bits_per_elem = compressors.payload_bits_per_elem(
-        comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask,
-        block_size=cfg.block_size,
-    )
     # Quantizer dither may (and, for variance reduction, should) differ across
     # workers: honour shared_mask=False the same way simulate mode does.
     # Random-K requires a shared key (checked above); Top-K uses no RNG.
@@ -333,17 +424,11 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
 
     check = getattr(cfg, "check_sync", False)
 
-    def leaf_bits(n: int, keep: int) -> float:
-        # blocktopk's dense-fallback leaves (keep == n) carry no block
-        # indices — plain fp32 values — so don't bill the index overhead
-        if comp.name == "blocktopk" and keep >= n:
-            return keep * 32.0
-        return keep * bits_per_elem
-
     def sync_flat(flat: Array, ef_flat, key: Array, world):
         """Returns ``(dense, new_ef, sent, bits, agree, overflow)``; ``sent``
         may be dynamic (threshold methods), the rest of the accounting is
-        static."""
+        static.  ``bits`` is MEASURED from the payload arrays each leaf sync
+        actually hands its collective — never an analytic per-element model."""
         acc = flat + ef_flat if ef_flat is not None else flat
         n = flat.shape[0]
         if n > (1 << 31) - 1 and comp.name not in ("terngrad", "qsgd"):
@@ -359,23 +444,22 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         if comp.name in ("thresholdv", "adaptive_threshold"):
             v = (cfg.threshold if comp.name == "thresholdv"
                  else jnp.max(jnp.abs(acc)) * 0.5)
-            dense, new_ef, sent_count, overflow = _leaf_sync_threshold(
+            dense, new_ef, sent_count, overflow, bits = _leaf_sync_threshold(
                 acc, v, keep, axis_name, world, ef_flat is not None)
-            # transport is the full cap-sized buffer: bill cap x 64 bits
+            # transport is the full cap-sized buffer even when half-empty
             return (dense, new_ef, sent_count.astype(jnp.float32),
-                    keep * 64.0, agree, overflow)
+                    bits, agree, overflow)
         if comp.name == "randomk":
-            dense, idx, agree = _leaf_sync_randomk(
+            dense, idx, agree, bits = _leaf_sync_randomk(
                 acc, key, keep, axis_name, world, check)
         elif comp.name == "topk":
             # with EF on the surplus is reabsorbed by the residual; with EF
             # off it is a real (silent) drop — count and report it
-            dense, idx, surplus = _leaf_sync_topk(
+            dense, idx, surplus, bits = _leaf_sync_topk(
                 acc, keep, axis_name, world, want_surplus=ef_flat is None)
             if surplus is not None:
                 new_ef = None
-                return (dense, new_ef, float(keep), leaf_bits(n, keep),
-                        agree, surplus)
+                return (dense, new_ef, float(keep), bits, agree, surplus)
         elif comp.name == "blocktopk":
             if keep >= flat.shape[0]:
                 # every block selected (leaves <= block_size always are, and
@@ -384,23 +468,24 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
                 # block rows — matches the reference protocol of never
                 # sending more than the dense tensor
                 dense = jax.lax.psum(acc, axis_name) / world
+                bits = _payload_bits(acc)
                 new_ef = jnp.zeros_like(acc) if ef_flat is not None else None
             else:
-                dense, new_ef = _leaf_sync_blocktopk(
+                dense, new_ef, bits = _leaf_sync_blocktopk(
                     acc, keep // cfg.block_size, cfg.block_size, axis_name,
                     world, ef_flat is not None)
-            return dense, new_ef, float(keep), leaf_bits(n, keep), agree, None
+            return dense, new_ef, float(keep), bits, agree, None
         elif comp.name == "terngrad":
-            dense = _leaf_sync_terngrad(
+            dense, bits = _leaf_sync_terngrad(
                 acc, key, cfg.terngrad_chunk, axis_name, world)
         else:  # qsgd
-            dense = _leaf_sync_qsgd(acc, key, cfg.qstates, axis_name, world)
+            dense, bits = _leaf_sync_qsgd(acc, key, cfg.qstates, axis_name, world)
         # EF residual = the coordinates that did NOT travel; zeroing the sent
         # ones in place of building a dense local reconstruction saves a full
         # scatter + elementwise pass at model scale.  EF with quantizers is
         # rejected at build time, so ef_flat != None implies a sparsifier.
         new_ef = acc.at[idx].set(0) if ef_flat is not None else None
-        return dense, new_ef, float(keep), leaf_bits(n, keep), agree, None
+        return dense, new_ef, float(keep), bits, agree, None
 
     def sync(grads: Any, ef: Any, key: Array) -> Tuple[Any, Any, Dict[str, Array]]:
         from tpu_compressed_dp.parallel.dp import (

@@ -326,7 +326,9 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     def sent_bits(comp_flat: jax.Array, sent: jax.Array) -> jax.Array:
         # blocktopk's keep-all/small leaves psum dense on the wire — no
         # block indices travel — so bill them 32 bits/elem, matching the
-        # wire path's leaf_bits (stats agree exactly across modes)
+        # wire engine's measured payload (stats agree across modes for the
+        # sparsifiers; quantizer wire bits additionally carry scale/padding
+        # overhead this analytic projection amortises away)
         if comp.name == "blocktopk":
             n = comp_flat.shape[0]
             kb = compressors.blocktopk_keep_blocks(n, cfg.ratio, cfg.block_size)
