@@ -244,3 +244,29 @@ class TestFusedSimulateEpilogue:
                                        np.asarray(ef_f[k]), rtol=1e-6)
         assert float(stats_f["sent_elems"]) == float(stats_ref["sent_elems"])
         assert float(stats_f["sent_bits"]) == float(stats_ref["sent_bits"])
+
+
+@pytest.mark.quick
+class TestTerngradChunkResolution:
+    """terngrad_chunk=-1 (auto, ADVICE r3): layerwise keeps the reference's
+    exact per-tensor global-max semantics on every leaf size; chunked scales
+    apply only where the reference has no working behavior to match."""
+
+    def test_auto_layerwise_is_per_tensor_max(self):
+        assert CompressionConfig(method="terngrad",
+                                 granularity="layerwise").resolved_terngrad_chunk == 0
+
+    def test_auto_entiremodel_and_bucketed_chunk(self):
+        for gran in ("entiremodel", "bucketed"):
+            assert CompressionConfig(
+                method="terngrad",
+                granularity=gran).resolved_terngrad_chunk == 1 << 21
+
+    def test_explicit_value_wins(self):
+        for gran in ("layerwise", "entiremodel"):
+            cfg = CompressionConfig(method="terngrad", granularity=gran,
+                                    terngrad_chunk=4096)
+            assert cfg.resolved_terngrad_chunk == 4096
+        assert CompressionConfig(
+            method="terngrad", granularity="entiremodel",
+            terngrad_chunk=0).resolved_terngrad_chunk == 0

@@ -76,6 +76,7 @@ def run_point(
     granularity: str = "layerwise",
     mode: str = "simulate",
     ratio: float = 0.01,
+    threshold: float = 1e-3,
     qstates: int = 255,
     block_size: int = 256,
     bucket_mb: float = 25.0,
@@ -108,6 +109,7 @@ def run_point(
     opt = SGD(lr=0.01, momentum=0.9, weight_decay=5e-4)
     cfg = CompressionConfig(
         method=method, granularity=granularity, mode=mode, ratio=ratio,
+        threshold=threshold,
         qstates=qstates, block_size=block_size, bucket_mb=bucket_mb,
         wire_cap_ratio=wire_cap_ratio,
         error_feedback=error_feedback,
@@ -198,6 +200,8 @@ def run_point(
         comp_gbps, dense_gbps = gbps_per_chip(ndev)
         record.update({
             "payload_mb_per_step": round(payload_mb, 4),
+            "payload_mb_psum": round(psum_mb, 4),
+            "payload_mb_allgather": round(ag_mb, 4),
             "dense_mb_per_step": round(dense_mb, 4),
             "transport": transport,
             "sent_frac": round(float(metrics["comm/sent_elems"])
@@ -244,7 +248,7 @@ def run_sweep(args) -> List[Dict[str, float]]:
         devices=args.devices, project_devices=args.project_devices,
         channels_scale=args.channels_scale,
         wire_cap_ratio=args.wire_cap_ratio,
-        mode=args.mode, qstates=args.qstates,
+        mode=args.mode, threshold=args.threshold, qstates=args.qstates,
         block_size=args.block_size,
         bucket_mb=args.bucket_mb,
         error_feedback=args.error_feedback,
@@ -300,6 +304,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="k values for topk/blocktopk/randomk (paper: 0.1%%,1%%,10%%)")
     p.add_argument("--granularities", default="layerwise,entiremodel")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
+    p.add_argument("--threshold", type=float, default=1e-3,
+                   help="V for thresholdv")
     p.add_argument("--qstates", type=int, default=255)
     p.add_argument("--block_size", type=int, default=256)
     p.add_argument("--bucket_mb", type=float, default=25.0)

@@ -178,17 +178,23 @@ class ImageFolder:
 
     def _save_size_cache(self, wh: np.ndarray) -> None:
         for path in self._cache_paths():
+            tmp = f"{path}.{os.getpid()}.tmp.npz"
             try:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 # NB np.savez appends '.npz' unless the name already ends
                 # with it — keep the suffix so os.replace finds the file
-                tmp = f"{path}.{os.getpid()}.tmp.npz"
                 np.savez_compressed(tmp, paths=self._rel_paths(), wh=wh,
                                     bytes=self._file_bytes())
                 os.replace(tmp, path)  # atomic vs concurrent processes
                 return
             except OSError:
-                continue  # read-only location: try the next candidate
+                # read-only location or partial write: drop any half-written
+                # temp before trying the next candidate
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                continue
 
     def sizes_bulk(self) -> np.ndarray:
         """All image sizes as ``[n, 2] (w, h)`` — cached on disk, scanned in

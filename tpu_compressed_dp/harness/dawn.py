@@ -52,43 +52,50 @@ def _scaled(ch: dict, scale: float) -> dict:
     return {k: max(8, int(v * scale)) for k, v in ch.items()}
 
 
-def _fixed_width(name: str, ctor, s: float):
-    # no width knob on these: refuse a non-1 scale instead of silently
-    # building full-width (would mislabel every downstream timing)
+def _fixed_width(name: str, ctor, s: float, dtype):
+    # no width/dtype knob on these: refuse a non-default instead of silently
+    # building full-width fp32 (would mislabel every downstream timing)
     if s != 1.0:
         raise ValueError(f"{name} does not support channels_scale")
+    if dtype != jnp.float32:
+        raise ValueError(f"{name} does not support --dtype (fp32 only)")
     return ctor()
 
 
 MODELS = {
     # channels_scale reproduces the width ablations of the reference's
     # experiments.ipynb (half/double width nets, SURVEY.md §6) and keeps CPU
-    # smoke tests fast.
-    "resnet9": lambda s=1.0: resnet9_mod.ResNet9(
-        channels=_scaled({"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}, s)
+    # smoke tests fast.  dtype=bfloat16 is the TPU-native mixed-precision
+    # posture (bf16 compute / fp32 masters; the reference's fp16util.py role).
+    "resnet9": lambda s=1.0, dtype=jnp.float32: resnet9_mod.ResNet9(
+        channels=_scaled({"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}, s),
+        dtype=dtype,
     ),
-    "alexnet": lambda s=1.0: resnet9_mod.AlexNetGraph(
+    "alexnet": lambda s=1.0, dtype=jnp.float32: resnet9_mod.AlexNetGraph(
         channels=_scaled(
             {"prep": 64, "layer1": 192, "layer2": 384, "layer3": 256, "layer4": 256}, s
-        )
+        ),
+        dtype=dtype,
     ),
-    "alexnet_module": lambda s=1.0: _fixed_width("alexnet_module", alexnet_mod.AlexNet, s),
-    "vgg16": lambda s=1.0: _fixed_width("vgg16", vgg_mod.vgg16, s),
+    "alexnet_module": lambda s=1.0, dtype=jnp.float32: _fixed_width(
+        "alexnet_module", alexnet_mod.AlexNet, s, dtype),
+    "vgg16": lambda s=1.0, dtype=jnp.float32: _fixed_width(
+        "vgg16", vgg_mod.vgg16, s, dtype),
     # spec-built variants via the graph runtime (`core.py:136`-equivalent)
-    "resnet9_graph": lambda s=1.0: _graph_net("resnet9", s),
-    "alexnet_graph": lambda s=1.0: _graph_net("alexnet", s),
+    "resnet9_graph": lambda s=1.0, dtype=jnp.float32: _graph_net("resnet9", s, dtype),
+    "alexnet_graph": lambda s=1.0, dtype=jnp.float32: _graph_net("alexnet", s, dtype),
 }
 
 
-def _graph_net(kind: str, scale: float):
+def _graph_net(kind: str, scale: float, dtype=jnp.float32):
     from tpu_compressed_dp.models import graph as graph_mod
 
     base = {"resnet9": {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512},
             "alexnet": {"prep": 64, "layer1": 192, "layer2": 384,
                         "layer3": 256, "layer4": 256}}[kind]
     ch = {k: max(int(v * scale), 8) for k, v in base.items()}
-    spec = (graph_mod.resnet9_spec(channels=ch) if kind == "resnet9"
-            else graph_mod.alexnet_spec(channels=ch))
+    spec = (graph_mod.resnet9_spec(channels=ch, dtype=dtype) if kind == "resnet9"
+            else graph_mod.alexnet_spec(channels=ch, dtype=dtype))
     return graph_mod.GraphNet(spec)
 
 
@@ -147,6 +154,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "acc under the 24-epoch protocol) for method x k "
                         "convergence sweeps")
     p.add_argument("--synthetic_n", type=int, default=2048, help="synthetic train-set size")
+    p.add_argument("--dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="compute dtype (params stay fp32 masters; bfloat16 "
+                        "is the TPU answer to the reference's fp16util.py)")
     p.add_argument("--channels_scale", type=float, default=1.0,
                    help="width multiplier for the graph-family nets")
     p.add_argument("--seed", type=int, default=0)
@@ -256,7 +267,8 @@ def run(args) -> dict:
         train_batches = ShardedBatches(train_batches, mesh, already_local=True)
         test_batches = ShardedBatches(test_batches, mesh, pad_to=bs)
 
-    module = MODELS[args.network](args.channels_scale)
+    module = MODELS[args.network](args.channels_scale,
+                                  dtype=jnp.dtype(args.dtype).type)
     params, stats = init_model(module, jax.random.key(args.seed),
                                jnp.zeros((1, 32, 32, 3), jnp.float32))
 

@@ -43,7 +43,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "GraphNet", "build_graph", "path_iter",
-    "Identity", "Mul", "Flatten", "Add", "Concat", "MaxPool",
+    "Identity", "Mul", "Flatten", "Add", "Concat", "MaxPool", "Cast",
     "resnet9_spec", "alexnet_spec",
 ]
 
@@ -79,6 +79,18 @@ class Add:
 class Concat:
     def __call__(self, *xs):
         return jnp.concatenate(xs, axis=-1)
+
+
+@dataclasses.dataclass
+class Cast:
+    """Dtype boundary node (no torch analog: the reference's fp16 wrapping
+    lived outside the graph, `fp16util.py`); used by the spec builders to
+    enter bf16 compute at the input and exit to fp32 logits."""
+
+    dtype: Any
+
+    def __call__(self, x):
+        return x.astype(self.dtype)
 
 
 @dataclasses.dataclass
@@ -199,9 +211,12 @@ class GraphNet(nn.Module):
 
 
 def resnet9_spec(num_classes: int = 10, channels: Optional[Dict[str, int]] = None,
-                 classifier_weight: float = 0.125) -> Dict:
+                 classifier_weight: float = 0.125,
+                 dtype: Any = jnp.float32) -> Dict:
     """`resnet9()` as a spec (`dawn.py:44-56,70-77`): residuals are explicit
-    Add edges, exactly how the reference wired them."""
+    Add edges, exactly how the reference wired them.  ``dtype=bfloat16``
+    wraps the graph in Cast boundary nodes (bf16 compute, fp32 params and
+    logits — the models/resnet9.py policy expressed as graph edges)."""
     from tpu_compressed_dp.models.resnet9 import ConvBN
 
     ch = channels or {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}
@@ -210,44 +225,49 @@ def resnet9_spec(num_classes: int = 10, channels: Optional[Dict[str, int]] = Non
         # `dawn.py:37-43`: residual branch + Add join back to the trunk
         return {
             "in": Identity(),
-            "res1": ConvBN(c),
-            "res2": ConvBN(c),
+            "res1": ConvBN(c, dtype=dtype),
+            "res2": ConvBN(c, dtype=dtype),
             "add": (Add(), ["./in", "./res2"]),
         }
 
     return {
-        "prep": ConvBN(ch["prep"]),
-        "layer1": {"conv": ConvBN(ch["layer1"]), "pool": MaxPool(2),
+        "cast_in": Cast(dtype),
+        "prep": ConvBN(ch["prep"], dtype=dtype),
+        "layer1": {"conv": ConvBN(ch["layer1"], dtype=dtype), "pool": MaxPool(2),
                    "residual": res_block(ch["layer1"])},
-        "layer2": {"conv": ConvBN(ch["layer2"]), "pool": MaxPool(2)},
-        "layer3": {"conv": ConvBN(ch["layer3"]), "pool": MaxPool(2),
+        "layer2": {"conv": ConvBN(ch["layer2"], dtype=dtype), "pool": MaxPool(2)},
+        "layer3": {"conv": ConvBN(ch["layer3"], dtype=dtype), "pool": MaxPool(2),
                    "residual": res_block(ch["layer3"])},
         "pool": MaxPool(4),
         "flatten": Flatten(),
-        "linear": nn.Dense(num_classes, use_bias=False),
+        "linear": nn.Dense(num_classes, use_bias=False, dtype=dtype),
         "logits": Mul(classifier_weight),
+        "cast_out": Cast(jnp.float32),
     }
 
 
 def alexnet_spec(num_classes: int = 10,
                  channels: Optional[Dict[str, int]] = None,
-                 classifier_weight: float = 0.125) -> Dict:
+                 classifier_weight: float = 0.125,
+                 dtype: Any = jnp.float32) -> Dict:
     """`alexnet()` as a spec (`dawn.py:57-68,79-82`)."""
     from tpu_compressed_dp.models.resnet9 import ConvBN
 
     ch = channels or {"prep": 64, "layer1": 192, "layer2": 384,
                       "layer3": 256, "layer4": 256}
     return {
-        "prep": ConvBN(ch["prep"], stride=2),
+        "cast_in": Cast(dtype),
+        "prep": ConvBN(ch["prep"], stride=2, dtype=dtype),
         "pool0": MaxPool(2),
-        "layer1": ConvBN(ch["layer1"]),
+        "layer1": ConvBN(ch["layer1"], dtype=dtype),
         "pool1": MaxPool(2),
-        "layer2": ConvBN(ch["layer2"]),
-        "layer3": ConvBN(ch["layer3"]),
-        "layer4": ConvBN(ch["layer4"]),
+        "layer2": ConvBN(ch["layer2"], dtype=dtype),
+        "layer3": ConvBN(ch["layer3"], dtype=dtype),
+        "layer4": ConvBN(ch["layer4"], dtype=dtype),
         "pool4": MaxPool(2),
         "pool5": MaxPool(2),
         "flatten": Flatten(),
-        "linear": nn.Dense(num_classes, use_bias=False),
+        "linear": nn.Dense(num_classes, use_bias=False, dtype=dtype),
         "logits": Mul(classifier_weight),
+        "cast_out": Cast(jnp.float32),
     }

@@ -41,6 +41,7 @@ class ConvBN(nn.Module):
     stride: int = 1
     bn_weight_init: float = 1.0
     bn_bias_init: float = 0.0
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -50,6 +51,7 @@ class ConvBN(nn.Module):
             strides=(self.stride, self.stride),
             padding=1,
             use_bias=False,
+            dtype=self.dtype,
             name="conv",
         )(x)
         x = nn.BatchNorm(
@@ -58,6 +60,7 @@ class ConvBN(nn.Module):
             epsilon=BN_EPS,
             scale_init=nn.initializers.constant(self.bn_weight_init),
             bias_init=nn.initializers.constant(self.bn_bias_init),
+            dtype=self.dtype,
             name="bn",
         )(x)
         return nn.relu(x)
@@ -68,11 +71,14 @@ class Residual(nn.Module):
 
     features: int
     bn_weight_init: float = 1.0
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        y = ConvBN(self.features, bn_weight_init=self.bn_weight_init, name="res1")(x, train)
-        y = ConvBN(self.features, bn_weight_init=self.bn_weight_init, name="res2")(y, train)
+        y = ConvBN(self.features, bn_weight_init=self.bn_weight_init,
+                   dtype=self.dtype, name="res1")(x, train)
+        y = ConvBN(self.features, bn_weight_init=self.bn_weight_init,
+                   dtype=self.dtype, name="res2")(y, train)
         return x + y
 
 
@@ -89,26 +95,36 @@ class ResNet9(nn.Module):
     res_layers: Sequence[str] = ("layer1", "layer3")
     extra_layers: Sequence[str] = ()
     bn_weight_init: float = 1.0
+    # bf16 compute / fp32 params, like models/resnet.py: flax keeps
+    # param_dtype=float32 masters, the MXU sees bf16 activations; logits are
+    # cast back to fp32 below so the loss/softmax run full-precision.
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         ch = self.channels or {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}
-        x = ConvBN(ch["prep"], bn_weight_init=self.bn_weight_init, name="prep")(x, train)
+        x = x.astype(self.dtype)
+        x = ConvBN(ch["prep"], bn_weight_init=self.bn_weight_init,
+                   dtype=self.dtype, name="prep")(x, train)
         for layer in ("layer1", "layer2", "layer3"):
-            x = ConvBN(ch[layer], bn_weight_init=self.bn_weight_init, name=layer)(x, train)
+            x = ConvBN(ch[layer], bn_weight_init=self.bn_weight_init,
+                       dtype=self.dtype, name=layer)(x, train)
             x = _maxpool(x, 2)
             if layer in self.extra_layers:
-                x = ConvBN(ch[layer], bn_weight_init=self.bn_weight_init, name=f"{layer}_extra")(
+                x = ConvBN(ch[layer], bn_weight_init=self.bn_weight_init,
+                           dtype=self.dtype, name=f"{layer}_extra")(
                     x, train
                 )
             if layer in self.res_layers:
-                x = Residual(ch[layer], bn_weight_init=self.bn_weight_init, name=f"{layer}_residual")(
+                x = Residual(ch[layer], bn_weight_init=self.bn_weight_init,
+                             dtype=self.dtype, name=f"{layer}_residual")(
                     x, train
                 )
         x = _maxpool(x, 4)
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.num_classes, use_bias=False, name="linear")(x)
-        return x * self.classifier_weight
+        x = nn.Dense(self.num_classes, use_bias=False, dtype=self.dtype,
+                     name="linear")(x)
+        return (x * self.classifier_weight).astype(jnp.float32)
 
 
 class AlexNetGraph(nn.Module):
@@ -117,19 +133,22 @@ class AlexNetGraph(nn.Module):
     num_classes: int = 10
     channels: Optional[Dict[str, int]] = None
     classifier_weight: float = 0.125
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         ch = self.channels or {"prep": 64, "layer1": 192, "layer2": 384, "layer3": 256, "layer4": 256}
-        x = ConvBN(ch["prep"], stride=2, name="prep")(x, train)
+        x = x.astype(self.dtype)
+        x = ConvBN(ch["prep"], stride=2, dtype=self.dtype, name="prep")(x, train)
         x = _maxpool(x, 2)
-        x = ConvBN(ch["layer1"], name="layer1")(x, train)
+        x = ConvBN(ch["layer1"], dtype=self.dtype, name="layer1")(x, train)
         x = _maxpool(x, 2)
-        x = ConvBN(ch["layer2"], name="layer2")(x, train)
-        x = ConvBN(ch["layer3"], name="layer3")(x, train)
-        x = ConvBN(ch["layer4"], name="layer4")(x, train)
+        x = ConvBN(ch["layer2"], dtype=self.dtype, name="layer2")(x, train)
+        x = ConvBN(ch["layer3"], dtype=self.dtype, name="layer3")(x, train)
+        x = ConvBN(ch["layer4"], dtype=self.dtype, name="layer4")(x, train)
         x = _maxpool(x, 2)
         x = _maxpool(x, 2)
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.num_classes, use_bias=False, name="linear")(x)
-        return x * self.classifier_weight
+        x = nn.Dense(self.num_classes, use_bias=False, dtype=self.dtype,
+                     name="linear")(x)
+        return (x * self.classifier_weight).astype(jnp.float32)

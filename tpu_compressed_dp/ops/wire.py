@@ -383,7 +383,7 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
     comp = compressors.get_compressor(
         cfg.method, ratio=cfg.ratio, threshold=cfg.threshold,
         qstates=cfg.qstates, block_size=cfg.block_size,
-        terngrad_chunk=cfg.terngrad_chunk,
+        terngrad_chunk=cfg.resolved_terngrad_chunk,
     )
     if comp.name not in WIRE_METHODS:
         raise NotImplementedError(
@@ -477,7 +477,7 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             return dense, new_ef, float(keep), bits, agree, None
         elif comp.name == "terngrad":
             dense, bits = _leaf_sync_terngrad(
-                acc, key, cfg.terngrad_chunk, axis_name, world)
+                acc, key, cfg.resolved_terngrad_chunk, axis_name, world)
         else:  # qsgd
             dense, bits = _leaf_sync_qsgd(acc, key, cfg.qstates, axis_name, world)
         # EF residual = the coordinates that did NOT travel; zeroing the sent

@@ -161,13 +161,16 @@ class CompressionConfig:
     # Overflowing survivors stay in the EF residual (or are dropped, EF off);
     # comm/threshold_overflow reports the clip count.
     wire_cap_ratio: float = 0.05
-    # terngrad: elements per scale chunk (0 = single global max).  A single
-    # max over an entire-model gradient drives keep-probabilities toward zero
-    # and the estimator variance unbounded (the r2 NaN row); one max per ~2M
-    # elements keeps entire-model granularity at layer-wise-like statistics.
-    # Leaves below the chunk size (all of ResNet-9/50's) are bit-identical to
-    # the reference's per-tensor max semantics.
-    terngrad_chunk: int = 1 << 21
+    # terngrad: elements per scale chunk (0 = single global max; -1 = auto).
+    # A single max over an entire-model gradient drives keep-probabilities
+    # toward zero and the estimator variance unbounded (the r2 NaN row); one
+    # max per ~2M elements keeps entire-model granularity at layer-wise-like
+    # statistics.  Auto resolves to 0 for layerwise (exact reference
+    # per-tensor max semantics on EVERY leaf, LM embedding included — a fixed
+    # 2M default silently diverged on >2M-element leaves, ADVICE r3) and to
+    # 2M for entiremodel/bucketed, where the reference has no working
+    # behavior to match (its path crashed, SURVEY.md §2.3.2).
+    terngrad_chunk: int = -1
 
     def __post_init__(self):
         if self.granularity not in ("layerwise", "entiremodel", "bucketed"):
@@ -188,6 +191,12 @@ class CompressionConfig:
         if self.shared_mask is not None:
             return self.shared_mask
         return self.mode == "wire"
+
+    @property
+    def resolved_terngrad_chunk(self) -> int:
+        if self.terngrad_chunk >= 0:
+            return self.terngrad_chunk
+        return 0 if self.granularity == "layerwise" else 1 << 21
 
 
 def init_ef_state(grads_like: Any, cfg: CompressionConfig, num_devices: Optional[int] = None) -> Any:
@@ -286,7 +295,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     comp = compressors.get_compressor(
         cfg.method, ratio=cfg.ratio, threshold=cfg.threshold,
         qstates=cfg.qstates, block_size=cfg.block_size,
-        terngrad_chunk=cfg.terngrad_chunk,
+        terngrad_chunk=cfg.resolved_terngrad_chunk,
     )
     if cfg.mode == "wire" and comp.name != "none":
         # Dense (method=None) has no sparse representation — the simulate
@@ -460,11 +469,23 @@ def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
             out_g.append(s_g)
             out_e.append(s_e)
             if sig:
-                s_comm = {k: jax.lax.psum(v, sig) for k, v in s_comm.items()}
-            comm = s_comm if comm is None else {
-                k: comm.get(k, 0.0) + s_comm.get(k, 0.0)
-                for k in set(comm) | set(s_comm)
-            }
+                # sync_agree is a 0/1 min-diagnostic, not an additive volume:
+                # psum over the signature axes (or summing across groups
+                # below) would inflate a unanimous 1.0 to the rank count.
+                s_comm = {k: (jax.lax.pmin(v, sig) if k == "sync_agree"
+                              else jax.lax.psum(v, sig))
+                          for k, v in s_comm.items()}
+            if comm is None:
+                comm = s_comm
+            else:
+                merged = {
+                    k: comm.get(k, 0.0) + s_comm.get(k, 0.0)
+                    for k in (set(comm) | set(s_comm)) - {"sync_agree"}
+                }
+                if "sync_agree" in comm and "sync_agree" in s_comm:
+                    merged["sync_agree"] = jnp.minimum(
+                        comm["sync_agree"], s_comm["sync_agree"])
+                comm = merged
         synced = merge(grads, out_g)
         new_ef = merge(ef, out_e) if use_ef else ()
         return synced, new_ef, comm
