@@ -89,3 +89,21 @@ def test_dtype_refused_on_models_without_the_knob(tmp_path):
     with _pytest.raises(ValueError, match="does not support --dtype"):
         run_dawn(tmp_path, epochs=1, network="vgg16", channels_scale=1.0,
                  dtype="bfloat16", batch_size=8, synthetic_n=64)
+
+
+@pytest.mark.quick
+def test_warmup_ratio_schedule_shared_source():
+    """The DGC sparsity warm-up schedule is a single module-level function
+    (harness applies it; tools/time_to_accuracy.py integrates it)."""
+    from tpu_compressed_dp.harness.dawn import warmup_ratio_for_epoch
+
+    seq = [warmup_ratio_for_epoch(e, ratio=0.01, warmup_epochs=16,
+                                  method="randomk") for e in range(18)]
+    assert seq[15] == seq[16] == seq[17] == 0.01   # reaches target, stays
+    assert all(a >= b for a, b in zip(seq, seq[1:]))  # monotone decay
+    assert seq[0] > 0.5 * 0.01 ** (1 / 16)         # starts near dense
+    # quantizers and dense never warm up
+    assert warmup_ratio_for_epoch(0, ratio=0.01, warmup_epochs=16,
+                                  method="terngrad") == 0.01
+    assert warmup_ratio_for_epoch(0, ratio=0.01, warmup_epochs=16,
+                                  method=None) == 0.01

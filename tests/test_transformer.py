@@ -47,6 +47,41 @@ class TestRingAttention:
         ref = dense_causal_attention(q, jnp.repeat(k, 2, 1), jnp.repeat(v, 2, 1))
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
 
+    def test_fused_gate_is_shape_and_backend_aware(self):
+        """The fused flash path engages only on TPU at lane-multiple seq and
+        MXU-friendly head_dim; on the CPU test backend it must stay off so
+        dense_causal_attention remains the independent reference."""
+        from tpu_compressed_dp.ops.ring_attention import use_fused_attention
+
+        on_tpu = jax.default_backend() == "tpu"
+        assert use_fused_attention((8, 12, 1024, 64), (8, 12, 1024, 64)) == on_tpu
+        # never at these shapes, regardless of backend:
+        assert not use_fused_attention((8, 12, 1000, 64), (8, 12, 1000, 64))
+        # t > 512 must be a 512-multiple (the kernel's block size)
+        assert not use_fused_attention((8, 12, 768, 64), (8, 12, 768, 64))
+        assert not use_fused_attention((8, 12, 64, 64), (8, 12, 64, 64))
+        assert not use_fused_attention((8, 12, 1024, 80), (8, 12, 1024, 80))
+        assert not use_fused_attention((8, 12, 1024, 64), (8, 12, 512, 64))
+
+    @pytest.mark.skipif(jax.default_backend() != "tpu",
+                        reason="fused flash path engages on TPU only")
+    def test_fused_matches_exact_on_tpu(self):  # pragma: no cover - TPU-only
+        import tpu_compressed_dp.ops.ring_attention as mod
+
+        keys = jax.random.split(jax.random.key(5), 3)
+        q = jax.random.normal(keys[0], (2, 4, 256, 64))
+        k = jax.random.normal(keys[1], (2, 4, 256, 64))
+        v = jax.random.normal(keys[2], (2, 4, 256, 64))
+        fused = ring_attention(q, k, v)
+        old = mod._FUSED_ATTN
+        mod._FUSED_ATTN = False
+        try:
+            exact = ring_attention(q, k, v)
+        finally:
+            mod._FUSED_ATTN = old
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(exact),
+                                   atol=5e-5)
+
     @pytest.mark.parametrize("ring", [2, 4])
     def test_ring_matches_dense(self, ring):
         mesh = jax.make_mesh((ring,), ("seq",))

@@ -530,3 +530,64 @@ class TestBucketedWire:
         exp_res = g0.copy()
         exp_res[idx] = 0.0
         np.testing.assert_allclose(np.asarray(ef1["w"]), exp_res, rtol=1e-5)
+
+
+class TestSegPackWirePath:
+    """The segmented shift-network kernel as the dispatched wire Top-K path
+    (round 4): forced through the interpreter on the CPU mesh, the sync must
+    match the default (global exact pack) path bit-for-bit when no segment
+    overflows its cap, and conserve gradient mass into EF when one does."""
+
+    def _patched(self, monkeypatch):
+        import functools
+
+        from tpu_compressed_dp.ops import kernels
+
+        monkeypatch.setattr(kernels, "use_seg_pack", lambda n, k: True)
+        monkeypatch.setattr(
+            kernels, "seg_pack_by_threshold",
+            functools.partial(kernels.seg_pack_by_threshold, interpret=True))
+
+    def test_matches_default_path_no_overflow(self, mesh8, monkeypatch):
+        grads = make_grads(n=700)
+        cfg = CompressionConfig(method="topk", ratio=0.05,
+                                granularity="entiremodel",
+                                mode="wire", error_feedback=True)
+        out_ref, ef_ref, stats_ref = run_sync(mesh8, cfg, grads)
+        self._patched(monkeypatch)
+        out_s, ef_s, stats_s = run_sync(mesh8, cfg, grads)
+        for leaf in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(out_ref[leaf]),
+                                       np.asarray(out_s[leaf]), rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(ef_ref[leaf]),
+                                       np.asarray(ef_s[leaf]), rtol=1e-6)
+        assert float(stats_s["sent_elems"]) == float(stats_ref["sent_elems"])
+        assert float(stats_s["sent_bits"]) == float(stats_ref["sent_bits"])
+
+    def test_ef_conserves_mass(self, mesh8, monkeypatch):
+        # sent + residual must equal the accumulated gradient coordinatewise
+        self._patched(monkeypatch)
+        grads = make_grads(n=900, seed=4)
+        cfg = CompressionConfig(method="topk", ratio=0.1,
+                                granularity="entiremodel",
+                                mode="wire", error_feedback=True)
+        out, ef, _ = run_sync(mesh8, cfg, grads)
+        # reconstruct: worker 0's contribution = its grads where sent
+        # (psum-averaged output is checked in the parity test; here assert
+        # residual + sent partition each worker's accumulated gradient)
+        g0 = jax.tree.map(lambda g: g[0], grads)
+        for leaf in ("w", "b"):
+            acc = np.asarray(g0[leaf]).reshape(-1)
+            res = np.asarray(ef[leaf]).reshape(-1)
+            sent_coords = res == 0.0
+            # every coordinate either kept whole in EF or fully sent
+            np.testing.assert_allclose(res[~sent_coords], acc[~sent_coords])
+
+    def test_surplus_reported_without_ef(self, mesh8, monkeypatch):
+        self._patched(monkeypatch)
+        grads = make_grads(n=700, seed=2)
+        cfg = CompressionConfig(method="topk", ratio=0.02,
+                                granularity="entiremodel", mode="wire",
+                                error_feedback=False)
+        _, _, stats = run_sync(mesh8, cfg, grads)
+        assert "topk_surplus_dropped" in stats

@@ -88,6 +88,22 @@ GRID = [
         "--compress", "entiremodel", "--method", "topk", "--ratio", "0.01",
         "--error_feedback", "--mode", "wire",
         "--lr_schedule", "step", "--peak_lr", "0.04"]),
+    # --- r4: the paper grid's hardest point, k=0.1% (VERDICT r3 #4) -------
+    # EF delay is ~1000 steps per coordinate; start from the k=1% winning
+    # recipe shape (step peak 0.04, warm-up, both clips) with the warm-up
+    # stretched — the geometric ramp needs more epochs to reach 1e-3.
+    ("randomk-em-0.1%-wire-EF-mom9", [
+        "--compress", "entiremodel", "--method", "randomk", "--ratio", "0.001",
+        "--error_feedback", "--mode", "wire",
+        "--lr_schedule", "step", "--peak_lr", "0.04",
+        "--epochs", "60", "--ratio_warmup_epochs", "16",
+        "--clip_norm", "1.0", "--clip_sent_norm", "1.0"]),
+    ("topk-em-0.1%-wire-EF-mom9", [
+        "--compress", "entiremodel", "--method", "topk", "--ratio", "0.001",
+        "--error_feedback", "--mode", "wire",
+        "--lr_schedule", "step", "--peak_lr", "0.04",
+        "--epochs", "60", "--ratio_warmup_epochs", "16",
+        "--clip_norm", "1.0", "--clip_sent_norm", "1.0"]),
 ]
 
 COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",

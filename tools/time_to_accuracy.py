@@ -42,7 +42,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
 
@@ -89,17 +88,17 @@ def arg_val(extra, flag, default=None):
 
 def effective_sent_frac(ratio: float, warmup_epochs: int, epochs: int) -> float:
     """Run-averaged sent fraction under the harness's geometric ratio
-    warm-up (``dawn.ratio_for_epoch``): ratio^((e+1)/n_w) for e < n_w."""
+    warm-up — integrates the harness's OWN per-epoch schedule
+    (``dawn.warmup_ratio_for_epoch``) so the projection can never drift from
+    what the convergence runs actually sent."""
+    from tpu_compressed_dp.harness.dawn import warmup_ratio_for_epoch
+
     if warmup_epochs <= 0 or ratio >= 1.0:
         return ratio
-    total = 0.0
-    for e in range(epochs):
-        if e >= warmup_epochs:
-            total += ratio
-        else:
-            r = ratio ** ((e + 1) / warmup_epochs)
-            digits = -int(math.floor(math.log10(abs(r)))) + 1
-            total += min(1.0, round(r, digits))
+    total = sum(
+        warmup_ratio_for_epoch(e, ratio=ratio, warmup_epochs=warmup_epochs,
+                               method="topk")
+        for e in range(epochs))
     return total / epochs
 
 

@@ -99,6 +99,25 @@ def _graph_net(kind: str, scale: float, dtype=jnp.float32):
     return graph_mod.GraphNet(spec)
 
 
+def warmup_ratio_for_epoch(epoch: int, *, ratio: float, warmup_epochs: int,
+                           method) -> float:
+    """DGC-style sparsity warm-up: geometric decay ``ratio^((e+1)/N)`` toward
+    ``ratio`` over the first ``warmup_epochs``, rounded to 2 significant
+    digits so close epochs share a compile.  The single source of the
+    schedule — the harness applies it per epoch and
+    tools/time_to_accuracy.py integrates it into ``effective_sent_frac``."""
+    from tpu_compressed_dp.ops.compressors import canonical_name
+
+    if (warmup_epochs <= 0 or epoch >= warmup_epochs or method is None
+            or canonical_name(method) not in ("topk", "randomk", "blocktopk")):
+        return ratio
+    r = ratio ** ((epoch + 1) / warmup_epochs)
+    from math import floor, log10
+
+    digits = -int(floor(log10(abs(r)))) + 1
+    return min(1.0, round(r, digits))
+
+
 def build_parser() -> argparse.ArgumentParser:
     # flag surface mirrors `dawn.py:8-20`
     p = argparse.ArgumentParser(description=__doc__)
@@ -320,19 +339,9 @@ def run(args) -> dict:
     comp = comp_for_ratio(args.ratio)
 
     def ratio_for_epoch(epoch: int) -> float:
-        # geometric decay target^((e+1)/N) -> target over the warm-up, rounded
-        # to 2 significant digits so close epochs share a compile
-        from tpu_compressed_dp.ops.compressors import canonical_name
-
-        n_w = args.ratio_warmup_epochs
-        if (n_w <= 0 or epoch >= n_w or comp.method is None
-                or canonical_name(comp.method) not in ("topk", "randomk", "blocktopk")):
-            return args.ratio
-        r = args.ratio ** ((epoch + 1) / n_w)
-        from math import floor, log10
-
-        digits = -int(floor(log10(abs(r)))) + 1
-        return min(1.0, round(r, digits))
+        return warmup_ratio_for_epoch(
+            epoch, ratio=args.ratio, warmup_epochs=args.ratio_warmup_epochs,
+            method=comp.method)
 
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp, ndev),

