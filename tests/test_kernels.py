@@ -401,9 +401,13 @@ class TestSegPack:
         np.testing.assert_allclose(np.asarray(pv), rv, rtol=1e-6)
         assert np.array_equal(np.asarray(pi), ri)
 
-    def test_dispatch_gate(self):
+    def test_dispatch_gate(self, monkeypatch):
         from tpu_compressed_dp.ops import kernels as K
 
+        # OFF by default everywhere (round-4 measured tie vs the unfused
+        # chain, with selection degradation on concentrated gradients)
+        assert not K.use_seg_pack(1 << 20, (1 << 20) // 100)
+        monkeypatch.setattr(K, "_SEG_PACK_DISPATCH", True)
         # density gate: keep/n beyond half the cap ratio -> exact global pack
         assert not K.use_seg_pack(1 << 20, (1 << 20) // 10)
         # int32 gate

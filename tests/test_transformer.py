@@ -57,8 +57,11 @@ class TestRingAttention:
         assert use_fused_attention((8, 12, 1024, 64), (8, 12, 1024, 64)) == on_tpu
         # never at these shapes, regardless of backend:
         assert not use_fused_attention((8, 12, 1000, 64), (8, 12, 1000, 64))
-        # t > 512 must be a 512-multiple (the kernel's block size)
-        assert not use_fused_attention((8, 12, 768, 64), (8, 12, 768, 64))
+        # in-repo kernel tiles any 128-multiple seq (768 -> block 128)
+        assert use_fused_attention((8, 12, 768, 64), (8, 12, 768, 64)) == on_tpu
+        # VMEM gate: the dkv backward holds full Q + packed cotangent
+        assert not use_fused_attention((1, 1, 1 << 15, 128),
+                                       (1, 1, 1 << 15, 128))
         assert not use_fused_attention((8, 12, 64, 64), (8, 12, 64, 64))
         assert not use_fused_attention((8, 12, 1024, 80), (8, 12, 1024, 80))
         assert not use_fused_attention((8, 12, 1024, 64), (8, 12, 512, 64))

@@ -779,9 +779,12 @@ def seg_pack_slots(n: int) -> int:
 
 def _roll_flat(a: Array, s: int, seg_rows: int):
     """Flattened-order left roll by static ``s`` on a [R, 128] block, with
-    row wrap INSIDE the block (callers mask cross-segment wraps)."""
+    row wrap INSIDE the block (callers mask cross-segment wraps).
+
+    NB roll-by-0 must short-circuit: Mosaic lowers jnp.roll to a slice pair
+    and rejects the zero-size half."""
     row_part, lane_part = divmod(s, _LANES)
-    a0 = jnp.roll(a, -row_part, axis=0)
+    a0 = a if row_part == 0 else jnp.roll(a, -row_part, axis=0)
     if lane_part == 0:
         return a0
     a1 = jnp.roll(a, -(row_part + 1), axis=0)
@@ -792,7 +795,7 @@ def _roll_flat(a: Array, s: int, seg_rows: int):
 
 
 def _seg_pack_kernel(n: int, keep: int, want_ef: bool, t_ref, x_ref,
-                     start_ref, *out_refs):
+                     start_ref, cnt_ref, *out_refs):
     if want_ef:
         vals_ref, idx_ref, ef_ref = out_refs
     else:
@@ -828,11 +831,10 @@ def _seg_pack_kernel(n: int, keep: int, want_ef: bool, t_ref, x_ref,
 
     eligible = jnp.logical_and(m, rank <= _SEG_CAP)
     if ef_ref is not None:
-        # start_ref: [_SEG_PER_BLOCK, 1] per-segment exclusive eligible-prefix
-        start = jnp.broadcast_to(
-            start_ref[:].reshape(rows // _SEG_ROWS, 1, 1),
-            (rows // _SEG_ROWS, _SEG_ROWS, _LANES)).reshape(rows, _LANES)
-        sent = jnp.logical_and(eligible, start + rank <= keep)
+        # start_ref: [rows, 1] per-ROW copy of the segment's exclusive
+        # eligible-prefix — [., 1] so the in-kernel broadcast is lane-only
+        # (Mosaic has no sublane+lane broadcast)
+        sent = jnp.logical_and(eligible, start_ref[:] + rank <= keep)
         ef_ref[:] = jnp.where(sent, 0.0, x)
 
     # route eligible survivors left by d = spos - (rank-1); d == 0 is dead
@@ -857,18 +859,13 @@ def _seg_pack_kernel(n: int, keep: int, want_ef: bool, t_ref, x_ref,
     v3 = vals.reshape(rows // _SEG_ROWS, _SEG_ROWS, _LANES)
     i3 = gidx.reshape(rows // _SEG_ROWS, _SEG_ROWS, _LANES)
     # mask dead tail slots (rank beyond count): their lanes carry stale
-    # values — zero value / index 0 are scatter-add identities
-    live3 = (jax.lax.broadcasted_iota(
-        jnp.int32, (rows // _SEG_ROWS, _SEG_ROWS, _LANES), 2)
-        < jnp.broadcast_to(
-            (rowpfx.reshape(rows // _SEG_ROWS, _SEG_ROWS, _LANES)
-             [:, _SEG_ROWS - 1:, _LANES - 1:]).astype(jnp.int32),
-            (rows // _SEG_ROWS, _SEG_ROWS, _LANES)))
-    # NB rowpfx's last row/lane is the segment's total SURVIVOR count; the
-    # payload holds min(count, cap) live slots — lane iota < count works for
-    # both because only row 0 is emitted (lane < 128 <= count when capped)
-    vals_ref[:] = jnp.where(live3[:, 0, :], v3[:, 0, :], 0.0)
-    idx_ref[:] = jnp.where(live3[:, 0, :], i3[:, 0, :], 0)
+    # values — zero value / index 0 are scatter-add identities.  cnt_ref is
+    # the per-segment survivor count [_SEG_PER_BLOCK, 1] (computed outside;
+    # [., 1] keeps the comparison's broadcast lane-only)
+    live = (jax.lax.broadcasted_iota(
+        jnp.int32, (rows // _SEG_ROWS, _LANES), 1) < cnt_ref[:])
+    vals_ref[:] = jnp.where(live, v3[:, 0, :], 0.0)
+    idx_ref[:] = jnp.where(live, i3[:, 0, :], 0)
 
 
 def seg_pack_by_threshold(acc: Array, t: Array, keep: int, *,
@@ -906,6 +903,7 @@ def seg_pack_by_threshold(acc: Array, t: Array, keep: int, *,
                      dtype=jnp.int32)
     elig = jnp.minimum(counts, _SEG_CAP)
     starts = (jnp.cumsum(elig) - elig).astype(jnp.int32)   # exclusive
+    start_rows = jnp.repeat(starts, _SEG_ROWS)[:, None]    # [rows, 1]
     blk = pl.BlockSpec((rows_blk, _LANES), lambda i: (i, 0),
                        memory_space=pltpu.VMEM)
     seg_out = pl.BlockSpec((_SEG_PER_BLOCK, _LANES), lambda i: (i, 0),
@@ -922,13 +920,16 @@ def seg_pack_by_threshold(acc: Array, t: Array, keep: int, *,
         in_specs=[
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             blk,
+            pl.BlockSpec((rows_blk, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((_SEG_PER_BLOCK, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
-    )(jnp.asarray(t).reshape(1, 1).astype(jnp.float32), x2d, starts[:, None])
+    )(jnp.asarray(t).reshape(1, 1).astype(jnp.float32), x2d, start_rows,
+      counts[:, None])
     new_ef = outs[2].reshape(-1)[:n] if want_ef else None
     return outs[0], outs[1], new_ef, elig, counts
 
@@ -955,15 +956,20 @@ def seg_pack_payload(vals: Array, idx: Array, elig: Array, keep: int):
     return pvals, pidx
 
 
+_SEG_PACK_DISPATCH = False
+
+
 def use_seg_pack(n: int, keep: int) -> bool:
     """Whether the wire Top-K path should take the segmented shift-network
-    kernel: TPU, big enough to matter, int32-indexable, and sparse enough
-    that the per-segment cap (128/4096 = 3.125%) is comfortably above the
-    keep density — at keep/n beyond half the cap ratio, uniform survivor
-    placement already risks structural overflow, so the exact global pack
-    serves those configs."""
-    return (_dispatch_to_pallas(n) and n <= _INT32_MAX
-            and keep * 2 * _SEG <= n * _SEG_CAP)
+    kernel.  OFF by default (round-4 measured result: at the 125M-param LM
+    config the kernel ties the unfused chain end-to-end — 45.0k vs 45.9k
+    tok/s — while segment-cap overflow on concentrated LM gradients drops
+    the effective sent fraction to ~0.5%; benchmarks/pack_kernel_r4.txt).
+    The structural gates remain for forced/experimental use: TPU,
+    int32-indexable, keep density comfortably under the per-segment cap
+    (128/4096 = 3.125%)."""
+    return (_SEG_PACK_DISPATCH and _dispatch_to_pallas(n)
+            and n <= _INT32_MAX and keep * 2 * _SEG <= n * _SEG_CAP)
 
 
 # ---------------------------------------------------------------------------

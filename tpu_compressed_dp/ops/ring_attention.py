@@ -48,8 +48,10 @@ _FUSED_ATTN = os.environ.get("TPU_CDP_FUSED_ATTN", "1") != "0"
 
 
 def use_fused_attention(q_shape, k_shape) -> bool:
-    """True when the single-block causal path should hit the fused kernel:
-    TPU backend, seq a lane multiple, head_dim MXU-friendly."""
+    """True when the single-block causal path should hit the fused kernel
+    (:mod:`tpu_compressed_dp.ops.flash_attention`): TPU backend, seq a lane
+    multiple, head_dim MXU-friendly, K/V small enough to stream through
+    VMEM whole."""
     if not _FUSED_ATTN:
         return False
     try:
@@ -58,26 +60,20 @@ def use_fused_attention(q_shape, k_shape) -> bool:
     except RuntimeError:  # pragma: no cover - backend not initialised
         return False
     b, h, t, d = q_shape
-    # t must tile by the kernel's block size: _fused_causal uses
-    # min(512, t), so t <= 512 (any lane multiple) or a 512-multiple
+    d_pad = d + (-d) % 128
+    # lanes of the packed cotangent (do | delta | lse) in the backward
+    d_store = d_pad if d_pad - d >= 2 else d_pad + 128
+    # worst resident set is the dkv backward: full K + V (forward holds the
+    # same) PLUS full Q and the packed cotangent, all fp32 in VMEM
+    resident = t * (2 * d_pad + d_pad + d_store) * 4
     return (t == k_shape[2] and t >= 128 and t % 128 == 0 and d % 64 == 0
-            and (t <= 512 or t % 512 == 0))
+            and resident <= 10 * 1024 * 1024)
 
 
 def _fused_causal(q: Array, k: Array, v: Array, scale: float) -> Array:
-    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+    from tpu_compressed_dp.ops.flash_attention import flash_causal_attention
 
-    t = q.shape[2]
-    bq = min(512, t)
-    bkv = min(512, t)
-    sizes = fa.BlockSizes(
-        block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
-        block_q_major_dkv=bq, block_k_major_dkv=bkv,
-        block_k_dkv=bkv, block_q_dkv=bq,
-        block_k_major_dq=bkv, block_k_dq=bkv, block_q_dq=bq,
-    )
-    return fa.flash_attention(q, k, v, causal=True, sm_scale=scale,
-                              block_sizes=sizes)
+    return flash_causal_attention(q, k, v, scale)
 
 
 def _block_attend(q, k, v, q_pos, k_pos, scale, o, m, l):
@@ -130,11 +126,14 @@ def ring_attention(
 
     if axis_name is None:
         ring, my = 1, 0
-        if use_fused_attention(q.shape, k.shape):
-            return _fused_causal(q, k, v, scale)
     else:
+        # the axis size is static at trace time — a size-1 seq axis (the LM
+        # harness always names the axis, sp=1 or not) degenerates to the
+        # single-block case and must hit the same fused path
         ring = jax.lax.psum(1, axis_name)
         my = jax.lax.axis_index(axis_name)
+    if ring == 1 and use_fused_attention(q.shape, k.shape):
+        return _fused_causal(q, k, v, scale)
 
     q_pos = my * t_local + jnp.arange(t_local)
     qf = q.astype(jnp.float32)
