@@ -26,7 +26,9 @@ Parameters are a plain nested dict with a parallel tree of
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -269,6 +271,7 @@ def apply_llama(
     tensor_axis: Optional[str] = None,
     seq_axis: Optional[str] = None,
     with_aux: bool = False,
+    return_hidden: bool = False,
 ):
     """Per-device forward: ``tokens`` [B_local, T_local] -> logits
     [B_local, T_local, V_local] (vocab-sharded when ``tensor_axis`` is set).
@@ -277,7 +280,9 @@ def apply_llama(
     all-gather is deliberately not offered (a [B,T,V] global tensor is the
     thing this layout exists to avoid).  With ``with_aux`` the return is
     ``(logits, aux)`` where aux is the mean MoE load-balance loss (0.0 for
-    dense configs).
+    dense configs).  ``return_hidden`` skips the head and yields the
+    final-normed hidden states instead of logits — the input
+    :func:`fused_head_xent` wants (it owns the head matmul).
     """
     dt = cfg.dtype
     hd = cfg.head_dim
@@ -329,10 +334,189 @@ def apply_llama(
             n_moe += 1
 
     h = _rms_norm(h, params["final_norm"], cfg.norm_eps)
-    logits = h @ params["lm_head"].astype(dt)  # [B, T, V_local]
+    if return_hidden:
+        out = h
+    else:
+        out = h @ params["lm_head"].astype(dt)  # [B, T, V_local]
     if with_aux:
-        return logits, aux_total / max(n_moe, 1)
-    return logits
+        return out, aux_total / max(n_moe, 1)
+    return out
+
+
+# fused head+xent is the default LM loss path (pure XLA — correct on every
+# backend); kill switch for A/Bs and debugging
+_FUSED_XENT = os.environ.get("TPU_CDP_FUSED_XENT", "1") != "0"
+
+
+def use_fused_head_xent() -> bool:
+    return _FUSED_XENT
+
+
+def _fhx_chunks(v_local: int, chunk: int):
+    """(chunk_size, n_chunks, v_padded) — pad the vocab up to whole chunks
+    (zero weight columns; masked to -inf in the running logsumexp)."""
+    c = min(chunk, v_local)
+    nc = -(-v_local // c)
+    return c, nc, nc * c
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_head_xent(h: Array, w: Array, targets: Array,
+                    tensor_axis: Optional[str] = None,
+                    chunk: int = 2048) -> Array:
+    """Mean next-token cross-entropy STRAIGHT from hidden states — the LM
+    head matmul and the softmax-xent fused through a running logsumexp over
+    vocab chunks, so the [N, V] logits (and AD's saved probabilities — at
+    the r4 LM config ~0.5-1.5 GB/step of HBM traffic) never materialise.
+
+    ``h`` [..., D], ``w`` [D, V_local] (vocab-sharded under
+    ``tensor_axis``), ``targets`` [...] global ids.  Numerically equal to
+    ``vocab_parallel_xent(h @ w, targets)`` (same max-shift, same psum
+    structure); the hand-written VJP recomputes each chunk's logits in the
+    backward (flash-attention discipline: trade one extra matmul pass for
+    the activation storage).
+    """
+    loss, _ = _fhx_fwd(h, w, targets, tensor_axis, chunk)
+    return loss
+
+
+def _fhx_scan_stats(h2, w, targets1, off, v_local, c, nc):
+    """Running (m, l, zt) over vocab chunks; w pre-padded to [D, nc*c]."""
+    n = h2.shape[0]
+    w3 = w.reshape(w.shape[0], nc, c)
+
+    def body(carry, xs):
+        m, l, zt = carry
+        w_c, ci = xs
+        z = jax.lax.dot_general(
+            h2, w_c, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [N, c]
+        col = ci * c + jnp.arange(c)
+        z = jnp.where(col[None, :] < v_local, z, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(z, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(z - m_new[:, None]), axis=-1)
+        lt = targets1 - off - ci * c
+        # membership needs BOTH chunk bounds and this shard's true vocab:
+        # a target owned by the next shard can alias into this shard's pad
+        # window (lt in [0, c) but targets1 - off >= v_local), where the
+        # masked -inf logit would poison zt through the psum
+        in_chunk = (lt >= 0) & (lt < c) & (targets1 - off < v_local)
+        zc = jnp.take_along_axis(
+            z, jnp.clip(lt, 0, c - 1)[:, None], axis=-1)[:, 0]
+        zt = zt + jnp.where(in_chunk, zc, 0.0)
+        return (m_new, l, zt), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+    # inside shard_map the body outputs are device-varying (they derive from
+    # the varying h/w/targets — targets can vary on axes h does not, e.g.
+    # pipe in the deferred-head uneven fallback); pcast the replicated init
+    # so scan's carry types match
+    vma = tuple(sorted(getattr(jax.typeof(h2), "vma", frozenset())
+                       | getattr(jax.typeof(w), "vma", frozenset())
+                       | getattr(jax.typeof(targets1), "vma", frozenset())))
+    if vma:
+        init = tuple(jax.lax.pcast(v, vma, to="varying") for v in init)
+    (m, l, zt), _ = jax.lax.scan(
+        body, init, (w3.transpose(1, 0, 2), jnp.arange(nc)))
+    return m, l, zt
+
+
+def _fhx_fwd(h, w, targets, tensor_axis, chunk):
+    d = h.shape[-1]
+    v_local = w.shape[-1]
+    h2 = h.reshape(-1, d)
+    targets1 = targets.reshape(-1)
+    n = h2.shape[0]
+    c, nc, v_pad = _fhx_chunks(v_local, chunk)
+    w_p = jnp.pad(w, ((0, 0), (0, v_pad - v_local)))
+    off = (jax.lax.axis_index(tensor_axis) * v_local
+           if tensor_axis is not None else 0)
+    m, l, zt = _fhx_scan_stats(h2, w_p, targets1, off, v_local, c, nc)
+    if tensor_axis is not None:
+        m_g = jax.lax.pmax(m, tensor_axis)
+        l = jax.lax.psum(l * jnp.exp(m - m_g), tensor_axis)
+        zt = jax.lax.psum(zt, tensor_axis)
+        m = m_g
+    lse = m + jnp.log(l)
+    loss = jnp.mean(lse - zt)
+    return loss, (h, w, targets, lse)
+
+
+def _fhx_bwd(tensor_axis, chunk, res, g):
+    import numpy as np
+
+    h, w, targets, lse = res
+    d = h.shape[-1]
+    v_local = w.shape[-1]
+    h2 = h.reshape(-1, d)
+    targets1 = targets.reshape(-1)
+    n = h2.shape[0]
+    c, nc, v_pad = _fhx_chunks(v_local, chunk)
+    w_p = jnp.pad(w, ((0, 0), (0, v_pad - v_local)))
+    off = (jax.lax.axis_index(tensor_axis) * v_local
+           if tensor_axis is not None else 0)
+    # pad columns need no mask: their z = h @ 0 gives p = exp(-lse) != 0,
+    # but that feeds dh only through w_c == 0 (inert) and dw only in the
+    # sliced-off pad columns; the onehot never lands there (targets are
+    # within the true vocab)
+    dnll = (g / n).astype(jnp.float32)
+    w3 = w_p.reshape(d, nc, c).transpose(1, 0, 2)
+
+    def body(dh, xs):
+        w_c, ci = xs
+        z = jax.lax.dot_general(
+            h2, w_c, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(z - lse[:, None])                     # [N, c]
+        lt = targets1 - off - ci * c
+        # same shard-membership guard as the forward (a pad-window alias
+        # would subtract the onehot from a zero-weight column — inert for
+        # dh/dw, but keep the two masks identical by construction)
+        lt = jnp.where(targets1 - off < v_local, lt, -1)
+        onehot = (jnp.arange(c)[None, :] == lt[:, None])
+        dz = ((p - onehot.astype(jnp.float32)) * dnll).astype(w_c.dtype)
+        dh = dh + jax.lax.dot_general(
+            dz, w_c, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw_c = jax.lax.dot_general(
+            h2, dz, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [d, c]
+        return dh, dw_c
+
+    dh0 = jnp.zeros((n, d), jnp.float32)
+    vma = tuple(sorted(getattr(jax.typeof(h2), "vma", frozenset())
+                       | getattr(jax.typeof(w_p), "vma", frozenset())
+                       | getattr(jax.typeof(lse), "vma", frozenset())
+                       | getattr(jax.typeof(targets1), "vma", frozenset())
+                       | getattr(jax.typeof(dnll), "vma", frozenset())))
+    if vma:
+        dh0 = jax.lax.pcast(dh0, vma, to="varying")
+    dh, dw_stack = jax.lax.scan(body, dh0, (w3, jnp.arange(nc)))
+    dw = dw_stack.transpose(1, 0, 2).reshape(d, v_pad)[:, :v_local]
+
+    # A cotangent's varying-mesh-axes must match its primal's: wherever the
+    # primal is REPLICATED over an axis the computation varies on (h across
+    # the vocab-sharded tensor axis; lm_head across pipeline stages), the
+    # true cotangent is the SUM of the per-shard partials.  The unfused path
+    # gets these psums inserted automatically as transposes of the implicit
+    # pvary where replicated values meet varying operands; a custom VJP must
+    # place them by hand.
+    def match_vma(ct, primal):
+        extra = tuple(sorted(getattr(jax.typeof(ct), "vma", frozenset())
+                             - getattr(jax.typeof(primal), "vma",
+                                       frozenset())))
+        return jax.lax.psum(ct, extra) if extra else ct
+
+    dh = match_vma(dh, h)
+    dw = match_vma(dw, w)
+    dt_ct = np.zeros(targets1.shape, dtype=jax.dtypes.float0)
+    return (dh.reshape(h.shape).astype(h.dtype), dw.astype(w.dtype),
+            dt_ct.reshape(targets.shape))
+
+
+fused_head_xent.defvjp(_fhx_fwd, _fhx_bwd)
 
 
 def vocab_parallel_xent(

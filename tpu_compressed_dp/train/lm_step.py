@@ -37,7 +37,9 @@ from jax import shard_map
 from tpu_compressed_dp.models.transformer import (
     LlamaConfig,
     apply_llama,
+    fused_head_xent,
     param_specs,
+    use_fused_head_xent,
     vocab_parallel_xent,
 )
 from tpu_compressed_dp.parallel.dp import (
@@ -143,9 +145,20 @@ def make_lm_train_step(
         comp_key = jax.random.fold_in(state.rng, state.step)
 
         def loss_fn(params):
-            logits, aux = apply_llama(cfg, params, x, tensor_axis="tensor",
-                                      seq_axis="seq", with_aux=True)
-            xent = vocab_parallel_xent(logits, y, tensor_axis="tensor")
+            if use_fused_head_xent():
+                # head matmul + softmax-xent fused through a chunked running
+                # logsumexp: the [B,T,V] logits (and AD's saved softmax
+                # inputs) never materialise in HBM
+                h, aux = apply_llama(cfg, params, x, tensor_axis="tensor",
+                                     seq_axis="seq", with_aux=True,
+                                     return_hidden=True)
+                xent = fused_head_xent(
+                    h, params["lm_head"].astype(cfg.dtype), y, "tensor")
+            else:
+                logits, aux = apply_llama(cfg, params, x,
+                                          tensor_axis="tensor",
+                                          seq_axis="seq", with_aux=True)
+                xent = vocab_parallel_xent(logits, y, tensor_axis="tensor")
             return xent + cfg.moe_aux_weight * aux, xent
 
         varying = jax.tree.map(

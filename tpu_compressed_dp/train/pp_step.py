@@ -50,6 +50,8 @@ from tpu_compressed_dp.models.transformer import (
     _psum_if,
     _rms_norm,
     _rope,
+    fused_head_xent,
+    use_fused_head_xent,
     vocab_parallel_xent,
 )
 from tpu_compressed_dp.ops.ring_attention import ring_attention
@@ -324,9 +326,15 @@ def make_pp_train_step(
                 my_y = jax.lax.pcast(ys, ("pipe",), to="varying")
             hn = _rms_norm(my_h.reshape(m_s * mb, t_len, cfg.dim),
                            params["final_norm"], cfg.norm_eps)
-            logits = hn @ params["lm_head"].astype(dt)  # [., T, V/tp]
-            nll = vocab_parallel_xent(logits, my_y.reshape(m_s * mb, t_len),
-                                      tensor_axis=tensor_axis)
+            if use_fused_head_xent():
+                nll = fused_head_xent(hn, params["lm_head"].astype(dt),
+                                      my_y.reshape(m_s * mb, t_len),
+                                      tensor_axis)
+            else:
+                logits = hn @ params["lm_head"].astype(dt)  # [., T, V/tp]
+                nll = vocab_parallel_xent(
+                    logits, my_y.reshape(m_s * mb, t_len),
+                    tensor_axis=tensor_axis)
             # equal chunks: mean of chunk-means == global mean
             loss = jax.lax.psum(nll * scale, "pipe")
             return loss
