@@ -343,9 +343,15 @@ def apply_llama(
     return out
 
 
-# fused head+xent is the default LM loss path (pure XLA — correct on every
-# backend); kill switch for A/Bs and debugging
-_FUSED_XENT = os.environ.get("TPU_CDP_FUSED_XENT", "1") != "0"
+# Fused head+xent is OPT-IN (TPU_CDP_FUSED_XENT=1): measured on chip at the
+# 125M / 32k-vocab / seq-1024 config it is ~5% SLOWER than the unfused chain
+# (115.3k vs 120.8k tok/s; chunk 8192 worse at 111.7k) — XLA fuses the
+# one-shot logits+softmax-xent well and the scan adds recompute.  Its value
+# is PEAK MEMORY: the [N, V] logits and their AD saves never materialise,
+# which is what matters at 100k+-vocab stretch configs where the logits
+# buffer rivals the weights.  Numerics: slightly MORE precise than the
+# unfused path at bf16 (fp32 logits inside the scan).
+_FUSED_XENT = os.environ.get("TPU_CDP_FUSED_XENT", "0") == "1"
 
 
 def use_fused_head_xent() -> bool:
