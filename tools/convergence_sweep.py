@@ -113,6 +113,15 @@ GRID = [
         "--lr_schedule", "step", "--peak_lr", "0.04",
         "--epochs", "90", "--ratio_warmup_epochs", "24",
         "--clip_norm", "1.0", "--clip_sent_norm", "1.0"]),
+    # the completing point of the k=0.1% operating map: 60/16 -> 0.70,
+    # 90/24 -> 0.926, 120/32 -> 0.9604 (~dense parity) — EF delay at
+    # k=0.1% costs ~2x the epochs, it does not need a different recipe
+    ("randomk-em-0.1%-wire-EF-mom9-120ep", [
+        "--compress", "entiremodel", "--method", "randomk", "--ratio", "0.001",
+        "--error_feedback", "--mode", "wire",
+        "--lr_schedule", "step", "--peak_lr", "0.04",
+        "--epochs", "120", "--ratio_warmup_epochs", "32",
+        "--clip_norm", "1.0", "--clip_sent_norm", "1.0"]),
 ]
 
 COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",
