@@ -122,6 +122,32 @@ GRID = [
         "--lr_schedule", "step", "--peak_lr", "0.04",
         "--epochs", "120", "--ratio_warmup_epochs", "32",
         "--clip_norm", "1.0", "--clip_sent_norm", "1.0"]),
+    # --- r5: threshold-family science (VERDICT r4 #6) ---------------------
+    # V-sweep: the reference's fixed-V operator at the default V=1e-3 ships
+    # 97% of coordinates (see thresholdv-lw above) — these rows raise V to
+    # trace out the accuracy + sent_frac vs V curve the paper's "V is hard
+    # to tune" claim implies (`CIFAR10/core.py:189-193`).  Protocol-faithful:
+    # no EF (the reference composes EF only with Random-K), 40 epochs (the
+    # 40-epoch rule covers Thresholdv, `dawn.py:105-108`).
+    ("thresholdv-lw-V3e-3", ["--compress", "layerwise", "--method",
+                             "thresholdv", "--threshold", "0.003"]),
+    ("thresholdv-lw-V1e-2", ["--compress", "layerwise", "--method",
+                             "thresholdv", "--threshold", "0.01"]),
+    ("thresholdv-lw-V3e-2", ["--compress", "layerwise", "--method",
+                             "thresholdv", "--threshold", "0.03"]),
+    ("thresholdv-lw-V1e-1", ["--compress", "layerwise", "--method",
+                             "thresholdv", "--threshold", "0.1"]),
+    # Adaptive-threshold (max|g|*0.5/layer, ~0.02% kept) sits at 0.485 in the
+    # 24-ep row: is that method-inherent or recipe?  The comparison set:
+    # 40-epoch rule alone, EF alone, both — topk at the SAME 0.1% density
+    # with EF reaches 0.9619, so EF is the mechanism hypothesis.
+    ("adaptive-lw-40ep", ["--compress", "layerwise", "--method",
+                          "adaptive_threshold", "--epochs", "40"]),
+    ("adaptive-lw-EF", ["--compress", "layerwise", "--method",
+                        "adaptive_threshold", "--error_feedback"]),
+    ("adaptive-lw-EF-40ep", ["--compress", "layerwise", "--method",
+                             "adaptive_threshold", "--error_feedback",
+                             "--epochs", "40"]),
 ]
 
 COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",

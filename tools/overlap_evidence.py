@@ -1,0 +1,189 @@
+"""Compiled-schedule evidence for the comm/compute overlap claim (VERDICT r4 #7).
+
+The reference overlaps gradient communication with backward compute through
+hand-registered autograd hooks + 25 MB buckets
+(`IMAGENET/training/ddp.py:429-456`).  This framework's round-1..4 answer was
+"XLA's scheduler handles it" — an assertion.  This tool replaces the
+assertion with the compiled artifact: it AOT-compiles the REAL CIFAR train
+step (`train/step.py:make_train_step`, the exact code the harness runs) for
+an 8-chip v5e topology (`jax.experimental.topologies` — no 8-chip hardware
+needed; the backend emits the true scheduled module, `is_scheduled=true`,
+with the production collective emitter configs) and reads the schedule:
+
+  * how many all-reduce instructions the module actually issues per step for
+    granularity = layerwise (one psum per parameter) / bucketed (25 MB) /
+    entiremodel — i.e. what XLA's all-reduce COMBINER does to the
+    collective count before scheduling;
+  * where collectives sit in the linear schedule relative to compute
+    (fusion/convolution/dot instructions): the fraction of compute scheduled
+    AFTER each collective measures how much backward work remains to hide
+    the collective behind — 0 after the last collective means the sync runs
+    fully exposed at the step's tail.
+
+Findings land in ``benchmarks/overlap_hlo_r5.txt`` and the PARITY.md
+overlap paragraph cites them.
+
+Usage:  python tools/overlap_evidence.py [--out benchmarks/overlap_hlo_r5.txt]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+COMPUTE_OPS = ("fusion", "convolution", "dot(", "dot.")
+COLLECTIVE_RE = re.compile(r"%(all-reduce|all-gather|reduce-scatter)"
+                           r"(?:-start)?[\.\s=]")
+
+
+def build_step(granularity: str, method, mesh, mode: str = "simulate"):
+    from tpu_compressed_dp.models.common import make_apply_fn
+    from tpu_compressed_dp.bench.sweep import _build_model
+    from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
+    from tpu_compressed_dp.train.optim import SGD
+    from tpu_compressed_dp.train.state import TrainState
+    from tpu_compressed_dp.train.step import make_train_step
+    from tpu_compressed_dp.models.common import init_model
+
+    module, sz, ncls = _build_model("resnet9", 32, 10, 1.0)
+    cfg = CompressionConfig(
+        method=method, granularity=granularity, mode=mode, ratio=0.01,
+        error_feedback=method is not None)
+    opt = SGD(lr=0.01, momentum=0.9, weight_decay=5e-4)
+
+    def make_state(seed):
+        params, stats = init_model(
+            module, jax.random.key(seed),
+            jnp.zeros((1, sz, sz, 3), jnp.float32))
+        return TrainState.create(
+            params, stats, opt.init(params),
+            init_ef_state(params, cfg, mesh.shape["data"]),
+            jax.random.key(seed + 1))
+
+    state_s = jax.eval_shape(make_state, 0)
+    bs = 512
+    batch_s = {
+        "input": jax.ShapeDtypeStruct((bs, sz, sz, 3), jnp.float32),
+        "target": jax.ShapeDtypeStruct((bs,), jnp.int32),
+    }
+    apply_fn = make_apply_fn(module)
+    step = make_train_step(apply_fn, opt, cfg, mesh, grad_scale=1.0)
+    return step, state_s, batch_s
+
+
+def schedule_stats(txt: str):
+    """Parse the scheduled ENTRY computation: instruction order IS the
+    schedule (``is_scheduled=true``)."""
+    entry = txt[txt.index("ENTRY "):]
+    lines = entry.splitlines()
+    compute_idx = []
+    coll = []  # (line_idx, opname, n_operands, bytes)
+    for i, ln in enumerate(lines):
+        s = ln.strip()
+        if not s.startswith("%"):
+            continue
+        if any(k in s.split("=")[0] or k in s.split("(")[0]
+               for k in ("fusion", "convolution")) or " dot(" in s:
+            compute_idx.append(i)
+        m = COLLECTIVE_RE.search(s)
+        if m and "= " in s and ("all-reduce(" in s or "all-gather(" in s
+                                or "reduce-scatter(" in s
+                                or "-start(" in s):
+            # operand count: top-level commas inside the call parens
+            call = s[s.index("(", s.index(m.group(1))):]
+            depth = 0
+            ops = 1
+            for ch in call:
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                elif ch == "," and depth == 1:
+                    ops += 1
+            # payload bytes: sum the shapes of the RESULT tuple (everything
+            # left of the call itself)
+            call_at = s.find(" " + m.group(1) + (
+                "-start(" if "-start(" in s else "("))
+            shapes = re.findall(r"(f32|bf16|f16|s32|u32)\[([\d,]*)\]",
+                                s[:call_at] if call_at > 0 else s)
+            nbytes = 0
+            for dt, dims in shapes:
+                e = 1
+                for d in dims.split(","):
+                    if d:
+                        e *= int(d)
+                nbytes += e * (2 if dt in ("bf16", "f16") else 4)
+            coll.append((i, m.group(1), ops, nbytes))
+    total_c = len(compute_idx)
+    rows = []
+    for i, name, ops, nbytes in coll:
+        after = sum(1 for c in compute_idx if c > i)
+        rows.append(dict(op=name, operands=ops, approx_mb=nbytes / 1e6,
+                         compute_after=after,
+                         compute_after_frac=after / max(total_c, 1)))
+    return rows, total_c
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="benchmarks/overlap_hlo_r5.txt")
+    ap.add_argument("--topology", default="v5e:2x4")
+    args = ap.parse_args(argv)
+
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=args.topology)
+    mesh = topologies.make_mesh(topo, (8,), ("data",))
+
+    cases = [
+        ("dense-layerwise", None, "layerwise"),
+        ("dense-bucketed-25MB", None, "bucketed"),
+        ("dense-entiremodel", None, "entiremodel"),
+        ("topk1%-EF-layerwise-simulate", "topk", "layerwise"),
+    ]
+    out_lines = [
+        f"# Compiled-schedule overlap evidence — tools/overlap_evidence.py",
+        f"# target: {args.topology} (8 chips), REAL train/step.py module,",
+        f"# AOT via jax.experimental.topologies (is_scheduled=true output of",
+        f"# the production TPU backend; instruction order = the schedule).",
+        f"# compute_after_frac: fraction of the module's compute instructions",
+        f"# scheduled AFTER the collective — backward work still available to",
+        f"# hide it behind.  0.0 => the collective runs fully exposed at the",
+        f"# step tail.", ""]
+    for label, method, gran in cases:
+        step, state_s, batch_s = build_step(gran, method, mesh)
+        # make_train_step returns a python wrapper around its internal jit;
+        # an outer jit inlines it and exposes .lower for AOT
+        txt = jax.jit(step).lower(state_s, batch_s).compile().as_text()
+        rows, total_c = schedule_stats(txt)
+        sched = "yes" if "is_scheduled=true" in txt else "NO"
+        out_lines.append(
+            f"== {label}: {len(rows)} collective instr "
+            f"(scheduled={sched}, {total_c} compute instr) ==")
+        for r in rows:
+            out_lines.append(
+                f"   {r['op']:14s} operands={r['operands']:3d} "
+                f"~{r['approx_mb']:8.2f} MB  "
+                f"compute_after={r['compute_after']:4d} "
+                f"({100*r['compute_after_frac']:5.1f}%)")
+        print(out_lines[-1 - len(rows)])
+        for ln in out_lines[-len(rows):]:
+            print(ln)
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write("\n".join(out_lines) + "\n")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -107,8 +107,13 @@ def measure_row(label: str, extra, cache: dict, steps: int, warmup: int):
     on the ResNet-9 bs-512 32px workload (the convergence grid's model).
 
     Returns ``(record, was_cache_hit)``; the cache key includes the
-    measurement parameters so a --steps/--warmup change re-measures."""
-    key = f"{label}@steps={steps},warmup={warmup}"
+    measurement parameters AND a hash of the grid point's args, so a
+    --steps/--warmup change — or a recipe change under an unchanged label
+    (ADVICE r4) — re-measures instead of silently reusing stale numbers."""
+    import hashlib
+
+    args_h = hashlib.md5(json.dumps(list(extra)).encode()).hexdigest()[:10]
+    key = f"{label}@steps={steps},warmup={warmup},args={args_h}"
     if key in cache:
         return cache[key], True
     from tpu_compressed_dp.bench.sweep import run_point
@@ -139,6 +144,11 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--measure_cache", default="benchmarks/.tta_measure_cache.json")
+    ap.add_argument("--sensitivity_out", default=None,
+                    help="also write a latency x overlap sensitivity TSV "
+                         "(VERDICT r4 #8): speedups vs dense at overlap in "
+                         "{0, 0.5, 1} and per-collective latency in "
+                         "{1, 10, 100} us")
     args = ap.parse_args(argv)
 
     conv = parse_tsv(args.convergence)
@@ -190,6 +200,25 @@ def main(argv=None):
         per_step = per_chip_traffic_bytes(p["psum_b"], p["ag_b"], w)
         return (p["tc_total"] / w,
                 p["epochs"] * steps_pe * per_step * p["traffic_scale"])
+
+    def n_collectives(p):
+        """Per-step collective count; measured where the sync engine reports
+        it, else the static bucket count a dense 25 MB-bucketed sync would
+        issue (dense rows carry no comm stats)."""
+        nc = p["rec"].get("num_collectives")
+        if nc:
+            return float(nc)
+        model_bytes = p["rec"].get("dense_mb_per_step", 26.0) * 1e6
+        return max(1.0, -(-model_bytes // (25 * 1024 * 1024)))
+
+    def wall_at(p, w, bw, lat_s=0.0, overlap=0.0):
+        """Projected seconds-to-target with a per-collective latency term and
+        an overlap fraction: comm exposed = max(0, comm - overlap * compute).
+        overlap=0 reproduces the original no-overlap model; overlap=1 is
+        perfect latency hiding (XLA's scheduler upper bound)."""
+        comp, traffic = totals(p, w)
+        comm = traffic / bw + p["epochs"] * steps_pe * n_collectives(p) * lat_s
+        return comp + max(0.0, comm - overlap * comp)
 
     cols = ["label", "method", "ratio", "mode", "epochs", "test_acc",
             "converged", "effective_sent_frac", "step_ms_1chip",
@@ -257,6 +286,53 @@ def main(argv=None):
         for r in out_rows:
             f.write("\t".join(str(r[c]) for c in cols) + "\n")
     print(f"wrote {args.out} ({len(out_rows)} rows)", file=sys.stderr)
+
+    if not args.sensitivity_out:
+        return
+
+    # --- latency x overlap sensitivity (VERDICT r4 #8) --------------------
+    # The headline projection bills layerwise's per-leaf collectives free of
+    # launch overhead and assumes zero overlap — both favour compression.
+    # This grid stresses both axes: per-collective latency 1/10/100 us and
+    # comm/compute overlap 0/0.5/1.  verdict column: "faster"/"slower" when
+    # the speedup vs dense stays on the same side of 1.0 across all nine
+    # combos, "mixed" when the conclusion depends on the assumption.
+    LATS = [1e-6, 10e-6, 100e-6]
+    OVERLAPS = [0.0, 0.5, 1.0]
+    scols = ["label", "w", "link", "n_collectives_per_step"]
+    for ov in OVERLAPS:
+        for lat in LATS:
+            scols.append(f"speedup_ov{ov:g}_lat{int(lat*1e6)}us")
+    scols.append("verdict")
+    with open(args.sensitivity_out, "w") as f:
+        f.write(
+            "# Sensitivity of the time-to-accuracy projection to the two\n"
+            "# assumptions the headline table fixes at zero: per-collective\n"
+            "# launch latency and comm/compute overlap.  speedup = dense\n"
+            "# wall-clock / method wall-clock to the same target, with BOTH\n"
+            "# sides paying the same latency and enjoying the same overlap\n"
+            "# fraction (exposed comm = max(0, comm - overlap*compute)).\n"
+            "# verdict: faster/slower = same side of 1.0 at every combo;\n"
+            "# mixed = the conclusion depends on the assumption.\n")
+        f.write("\t".join(scols) + "\n")
+        for p in physics:
+            if p is dense:
+                continue
+            for w in WORLDS:
+                for name, bw in BANDWIDTHS:
+                    r = {"label": p["row"]["label"], "w": w, "link": name,
+                         "n_collectives_per_step": n_collectives(p)}
+                    sps = []
+                    for ov in OVERLAPS:
+                        for lat in LATS:
+                            sp = (wall_at(dense, w, bw, lat, ov)
+                                  / wall_at(p, w, bw, lat, ov))
+                            r[f"speedup_ov{ov:g}_lat{int(lat*1e6)}us"] = round(sp, 3)
+                            sps.append(sp)
+                    r["verdict"] = ("faster" if min(sps) > 1.0 else
+                                    "slower" if max(sps) < 1.0 else "mixed")
+                    f.write("\t".join(str(r[c]) for c in scols) + "\n")
+    print(f"wrote {args.sensitivity_out}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,245 @@
+"""Stage-accounted profile of the element-wise wire Top-K sync chain.
+
+The element Top-K wire path (`ops/wire.py:_leaf_sync_topk`) has been the
+framework's slowest mode for three rounds (~2.4x dense at the 125M LM
+config).  Round 4's diagnosis named four element-granular stages — threshold,
+payload gather, scatter-add reconstruction, EF scatter — without individual
+numbers on the current code.  This tool produces those numbers the trustworthy
+way (round-4 memory: standalone op timings at this scale thrash the allocator
+and lie): a ladder of CUMULATIVE prefix chains, each jitted with donated
+inputs and run under `shard_map` over a 1-device data axis exactly like the
+harness step; per-stage cost is the difference between consecutive rungs.
+Every rung returns a scalar that data-depends on all its stages so XLA cannot
+DCE a stage out of a longer rung.
+
+Usage (on the TPU chip):
+    python tools/wire_profile.py --n 125000000 --ratio 0.01 [--iters 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from tpu_compressed_dp.ops import compressors, kernels, wire
+
+
+def _stage_chain(upto: str, n: int, keep: int, axis_name: str = "data"):
+    """Build a chain running stages up to and including `upto`.
+
+    Stage order: mag -> threshold -> pack -> gather -> combine -> ef.
+    Returns (out_scalar,) so everything stays live.
+    """
+
+    def chain(flat: jax.Array):
+        mag = jnp.abs(flat).astype(jnp.float32)
+        out = jnp.sum(mag[:8])
+        if upto == "mag":
+            return out
+        t = kernels.topk_threshold(mag, keep)
+        out = out + t
+        if upto == "threshold":
+            return out
+        mask = mag >= t
+        idx = wire.packed_indices_from_mask(mask, keep)
+        out = out + jnp.sum(idx[:8].astype(jnp.float32))
+        if upto == "pack":
+            return out
+        payload = flat[idx]
+        out = out + jnp.sum(payload[:8])
+        if upto == "gather":
+            return out
+        world = jax.lax.psum(1, axis_name)
+        g_vals = wire._all_gather(payload, axis_name)
+        g_idx = wire._all_gather(idx, axis_name)
+        dense = (jnp.zeros(flat.shape, flat.dtype)
+                 .at[g_idx.reshape(-1)].add(g_vals.reshape(-1)) / world)
+        out = out + jnp.sum(dense[:8])
+        if upto == "combine":
+            return out
+        new_ef = flat.at[idx].set(0)
+        out = out + jnp.sum(new_ef[:8])
+        return out
+
+    return chain
+
+
+def _pack_sub_chain(upto: str, n: int, keep: int):
+    """Sub-stages of packed_indices_from_mask, cumulative from threshold."""
+
+    def chain(flat: jax.Array):
+        lanes = 128
+        mag = jnp.abs(flat).astype(jnp.float32)
+        t = kernels.topk_threshold(mag, keep)
+        mask = mag >= t
+        pad = (-n) % lanes
+        m2 = jnp.pad(mask, (0, pad)).reshape(-1, lanes)
+        row_counts = jnp.sum(m2, axis=1, dtype=jnp.int32)
+        out = jnp.sum(row_counts[:8].astype(jnp.float32))
+        if upto == "p_rowcounts":
+            return out
+        row_ends = jnp.cumsum(row_counts)
+        ends_hist = jnp.zeros((keep + 1,), jnp.int32).at[
+            jnp.minimum(row_ends, keep)].add(
+                1, indices_are_sorted=True, mode="promise_in_bounds")
+        out = out + jnp.sum(ends_hist[:8].astype(jnp.float32))
+        if upto == "p_hist":
+            return out
+        row_of = jnp.cumsum(ends_hist)[:keep]
+        valid = row_of < m2.shape[0]
+        row_of = jnp.where(valid, row_of, m2.shape[0] - 1)
+        out = out + jnp.sum(row_of[:8].astype(jnp.float32))
+        if upto == "p_rowof":
+            return out
+        ranks = jnp.arange(1, keep + 1, dtype=jnp.int32)
+        row_starts = wire._sorted_gather(row_ends, row_of) - wire._sorted_gather(
+            row_counts, row_of)
+        within = ranks - row_starts
+        out = out + jnp.sum(within[:8].astype(jnp.float32))
+        if upto == "p_smallgather":
+            return out
+        rows = wire._sorted_gather(m2, row_of).astype(jnp.float32)
+        out = out + jnp.sum(rows[:8])
+        if upto == "p_rowgather":
+            return out
+        tri = jnp.tril(jnp.ones((lanes, lanes), jnp.float32))
+        prefix = rows @ tri.T
+        hit = (prefix >= within[:, None].astype(jnp.float32)) & (rows > 0)
+        col = jnp.argmax(hit, axis=1).astype(jnp.int32)
+        idx = jnp.where(valid, row_of * lanes + col, 0)
+        return out + jnp.sum(idx[:8].astype(jnp.float32))
+
+    return chain
+
+
+PACK_SUBS = ["p_rowcounts", "p_hist", "p_rowof", "p_smallgather",
+             "p_rowgather", "p_matmul"]
+
+
+def _pack_scatter_chain(n: int, keep: int, axis_name: str = "data"):
+    """EXPERIMENT: replace pack+gather+EF with one elementwise slot
+    computation + a sorted full-tensor scatter-add.
+
+    Every element's payload slot is computable without any per-rank gather:
+    ``slot = row_start[row] + in_row_prefix - 1`` (in-row prefix = one MXU
+    tri-matmul over the full mask).  Dead elements alias the most recent
+    live slot with a 0 contribution, keeping the flattened slot sequence
+    nondecreasing, so ONE scatter-add with ``indices_are_sorted=True``
+    emits the packed (values, indices) payload in a single streaming pass —
+    if XLA's TPU scatter lowering honours the hint.  EF is elementwise.
+    """
+
+    def chain(flat: jax.Array):
+        lanes = 128
+        mag = jnp.abs(flat).astype(jnp.float32)
+        t = kernels.topk_threshold(mag, keep)
+        pad = (-n) % lanes
+        m2 = jnp.pad(mag >= t, (0, pad)).reshape(-1, lanes)
+        cnt = jnp.sum(m2, axis=1, dtype=jnp.int32)
+        row_end = jnp.cumsum(cnt)
+        row_start = row_end - cnt
+        tri = jnp.tril(jnp.ones((lanes, lanes), jnp.float32))
+        prefix = (m2.astype(jnp.float32) @ tri.T).astype(jnp.int32)  # inclusive
+        slot = row_start[:, None] + jnp.maximum(prefix - 1, 0)
+        slot = jnp.minimum(slot, keep)          # overflow + tail -> slot `keep`
+        live = m2 & (slot < keep) & (prefix > 0)
+        sf = slot.reshape(-1)
+        acc_pad = jnp.pad(flat, (0, pad))
+        pos = jnp.arange(n + pad, dtype=jnp.int32)
+        contrib_v = jnp.where(live.reshape(-1), acc_pad, 0.0)
+        contrib_i = jnp.where(live.reshape(-1), pos, 0)
+        vals = jnp.zeros((keep + 1,), flat.dtype).at[sf].add(
+            contrib_v, indices_are_sorted=True, mode="promise_in_bounds")[:keep]
+        idx = jnp.zeros((keep + 1,), jnp.int32).at[sf].add(
+            contrib_i, indices_are_sorted=True, mode="promise_in_bounds")[:keep]
+        new_ef = jnp.where(mag >= t, 0.0, flat)          # elementwise EF
+        world = jax.lax.psum(1, axis_name)
+        g_vals = wire._all_gather(vals, axis_name)
+        g_idx = wire._all_gather(idx, axis_name)
+        dense = (jnp.zeros(flat.shape, flat.dtype)
+                 .at[g_idx.reshape(-1)].add(g_vals.reshape(-1)) / world)
+        return jnp.sum(dense[:8]) + jnp.sum(new_ef[:8]) + jnp.sum(vals[:8])
+
+    return chain
+
+
+STAGES = ["mag", "threshold", "pack", "gather", "combine", "ef"]
+
+
+def time_fn(fn, x, iters: int, warmup_s: float = 3.0):
+    """Round-4 discipline: time-based warmup with a value fetch per burst
+    (`jax.device_get` is the barrier; `block_until_ready` is not on axon)."""
+    t_end = time.time() + warmup_s
+    while time.time() < t_end:
+        jax.device_get(fn(x))
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(x)
+    jax.device_get(out)
+    return (time.time() - t0) / iters
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=125_000_000)
+    ap.add_argument("--ratio", type=float, default=0.01)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--subs", action="store_true",
+                    help="also profile packed_indices_from_mask sub-stages")
+    ap.add_argument("--pack2", action="store_true",
+                    help="run the (negative-result) full-scatter formulation")
+    args = ap.parse_args(argv)
+
+    n = args.n
+    keep = compressors.topk_keep_count(n, args.ratio)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(args.seed), (n,), jnp.float32))
+
+    print(f"# wire Top-K stage ladder: n={n} keep={keep} "
+          f"({100*keep/n:.2f}%) device={jax.devices()[0].platform}")
+    prev = 0.0
+    rows = []
+    for st in STAGES:
+        fn = jax.jit(shard_map(
+            _stage_chain(st, n, keep),
+            mesh=mesh, in_specs=P(), out_specs=P()))
+        dt = time_fn(fn, x, args.iters)
+        rows.append((st, dt * 1e3, (dt - prev) * 1e3))
+        print(f"{st:10s} cumulative {dt*1e3:8.2f} ms   stage {max((dt-prev)*1e3, 0.0):8.2f} ms")
+        prev = dt
+    total = rows[-1][1]
+    print(f"# chain total {total:.2f} ms; element-granular random-access "
+          f"stages = gather+combine+ef")
+    if args.subs:
+        prev = rows[1][1] / 1e3   # threshold rung is the sub-ladder's base
+        print("# pack sub-stages (cumulative from threshold rung):")
+        for st in PACK_SUBS:
+            fn = jax.jit(shard_map(_pack_sub_chain(st, n, keep),
+                                   mesh=mesh, in_specs=P(), out_specs=P()))
+            dt = time_fn(fn, x, args.iters)
+            print(f"{st:14s} cumulative {dt*1e3:8.2f} ms   "
+                  f"stage {max((dt-prev)*1e3, 0.0):8.2f} ms")
+            prev = dt
+    if args.pack2:
+        fn = jax.jit(shard_map(_pack_scatter_chain(n, keep),
+                               mesh=mesh, in_specs=P(), out_specs=P()))
+        dt = time_fn(fn, x, args.iters)
+        print(f"pack2-scatter-formulation full chain {dt*1e3:8.2f} ms "
+              f"(vs ladder total {total:.2f} ms)")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,13 @@ _NEG_INF = -1e30
 # per-step K/V blocks already bound the score working set to [T_loc, T_loc],
 # and block outputs merge through the (o, m, l) carry that a fused kernel
 # would have to export anyway.
+#
+# Operand-precision note (ADVICE r4): for bf16 models the kernel feeds bf16
+# q/k straight to the MXU (fp32 accumulation), while the unfused path
+# upcast q/k to fp32 before the score matmul — so enabling the default-on
+# kernel shifts bf16 loss curves at the last-ulp level.  This matches
+# standard XLA attention practice; set TPU_CDP_FUSED_ATTN=0 to recover the
+# old operand precision when diffing curves against pre-round-4 runs.
 _FUSED_ATTN = os.environ.get("TPU_CDP_FUSED_ATTN", "1") != "0"
 
 

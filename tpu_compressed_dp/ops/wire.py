@@ -159,6 +159,42 @@ def qsgd_wire_unpack(payload: tuple[Array, ...], n: int, qstates: int,
     return jnp.where(neg, -mags.astype(dtype), mags.astype(dtype))
 
 
+def _sorted_gather(a: Array, idx: Array) -> Array:
+    """``a[idx]`` where ``idx`` is known ascending (not necessarily unique)
+    and in bounds.  The hints matter at wire scale: XLA's general gather
+    assumes arbitrary indices; sorted+in-bounds lowers to a cheaper sequence
+    on TPU for the k~1M element-granular loads this path lives on."""
+    return a.at[idx].get(indices_are_sorted=True, mode="promise_in_bounds")
+
+
+def _scatter_combine(shape, dtype, g_idx: Array, g_vals: Array, world,
+                     block_size: int = 0) -> Array:
+    """Gathered ``[W, k]`` (indices, values) payload -> dense sum / world.
+
+    Each worker's index row is ascending and unique by construction
+    (`packed_indices_from_mask`), but a flattened ``[W*k]`` scatter-add
+    forfeits that: XLA must assume arbitrary duplicate order.  Per-row
+    scatters keep the ``indices_are_sorted`` / ``unique_indices`` hints
+    alive; ``W`` is a static mesh size so the loop unrolls at trace time.
+    Beyond 16 rows fall back to the single fused scatter (compile-size
+    guard — the hint's win is per-element dispatch, already amortised at
+    large ``W``).  ``block_size > 0`` scatters contiguous value rows
+    (Block-Top-K payloads, ``g_vals: [W, kb, bs]``).
+    """
+    W = g_idx.shape[0]
+    dense = jnp.zeros(shape, dtype)
+    if W <= 16:
+        for w in range(W):
+            dense = dense.at[g_idx[w]].add(
+                g_vals[w], indices_are_sorted=True, unique_indices=True,
+                mode="promise_in_bounds")
+    else:
+        vals = (g_vals.reshape(-1, block_size) if block_size
+                else g_vals.reshape(-1))
+        dense = dense.at[g_idx.reshape(-1)].add(vals)
+    return dense / world
+
+
 def packed_indices_from_mask(mask: Array, keep: int) -> Array:
     """Ascending indices of the first ``keep`` True positions of ``mask``.
 
@@ -169,35 +205,57 @@ def packed_indices_from_mask(mask: Array, keep: int) -> Array:
     ``jnp.nonzero(size=)`` and a flat 1-D cumsum both lower poorly on TPU at
     gradient scale (~400ms / ~190ms at 42M elements).  Hierarchical stream
     compaction instead: per-128-lane-row counts (one linear reduce), a small
-    cumsum over row totals, a rank→row map, then an in-row prefix via a
-    lower-triangular matmul on the gathered rows — every stage linear or
-    MXU-shaped.  The rank→row map is ``searchsorted(row_ends, rank)`` in
-    spirit, but since the queries are exactly the consecutive ranks
-    ``1..keep``, it is computed by bucketing each row's inclusive end and
-    prefix-summing — ``#{i : row_ends[i] < r}`` — which replaced the
-    binary search's serialized gather chain (258ms → ~25ms at 170M).
+    cumsum over row totals, then a rank->row map via bucketing each row's
+    inclusive end and prefix-summing — ``row_of[r-1] = #{i : row_ends[i] < r}``
+    (== searchsorted(row_ends, r, left)) — which replaced a binary search's
+    serialized gather chain (258ms -> ~25ms at 170M, round 2).
+
+    The per-rank stage is TWO gathers per rank (round 5; was three + an
+    fp32 tri-matmul): per-rank costs are billed per random ACCESS, and the
+    round-5 bisect (tools/wire_profile.py --subs and the scratch bisect in
+    benchmarks/wire_wall_r5.txt) measured ~7 ms per [keep]-sized gather at
+    keep=1.25M — so gathering ``row_ends`` and ``row_counts`` separately
+    just to subtract them was a wasted 8 ms: one precomputed ``row_starts``
+    array halves that stage.  The in-row prefix matmul runs in bf16 (row
+    prefix counts are <= 128, exactly representable), halving the gathered
+    rows' materialisation traffic vs fp32.  Two rejected redesigns, both
+    measured slower: bit-packing rows into uint32 words for a single
+    32-byte-row gather (the uint32 pack pass itself costs ~30 ms — integer
+    multiply-reduce over the full tensor does not vectorise well on the
+    VPU), and a full-tensor scatter formulation emitting (idx, val) pairs
+    elementwise (XLA does not stream sorted 125M-update scatters: 2.2 s).
     """
     lanes = 128
     n = mask.shape[0]
     pad = (-n) % lanes
     m2 = jnp.pad(mask, (0, pad)).reshape(-1, lanes)
+    nrows = m2.shape[0]
     row_counts = jnp.sum(m2, axis=1, dtype=jnp.int32)
     # NB: plain 1-D cumsum here — at the ~n/128 and ~keep sizes these run at,
     # XLA's native scan beats a hand-rolled two-level decomposition (measured
     # +18ms/step at LM scale from a hier_cumsum variant, round 2)
     row_ends = jnp.cumsum(row_counts)                      # inclusive offsets
     ranks = jnp.arange(1, keep + 1, dtype=jnp.int32)
-    # row_of[r-1] = #{i : row_ends[i] < r}  (== searchsorted(row_ends, r, left))
-    ends_hist = jnp.zeros((keep + 1,), jnp.int32).at[jnp.minimum(row_ends, keep)].add(1)
+    # row_ends is a cumsum — monotone — so the histogram scatter and the
+    # gathers below ride the sorted-indices fast path
+    ends_hist = jnp.zeros((keep + 1,), jnp.int32).at[
+        jnp.minimum(row_ends, keep)].add(
+            1, indices_are_sorted=True, mode="promise_in_bounds")
     row_of = jnp.cumsum(ends_hist)[:keep]
-    valid = row_of < m2.shape[0]                           # rank <= total count
-    row_of = jnp.where(valid, row_of, 0)
-    # rank within the row: global rank minus everything before the row
-    row_starts = row_ends[row_of] - row_counts[row_of]
-    within = ranks - row_starts                             # 1-based in-row rank
-    rows = m2[row_of].astype(jnp.float32)                   # [keep, 128]
-    tri = jnp.tril(jnp.ones((lanes, lanes), jnp.float32))
-    prefix = rows @ tri.T                                   # inclusive prefix
+    valid = row_of < nrows                                 # rank <= total count
+    # pad invalid ranks with the LAST row (not row 0): keeps row_of monotone
+    # so the sorted-gather hints stay truthful; the final jnp.where still
+    # returns index 0 for invalid ranks
+    row_of = jnp.where(valid, row_of, nrows - 1)
+    # rank within the row: global rank minus everything before the row —
+    # ONE gather of the precomputed starts, not two of ends and counts
+    row_starts = _sorted_gather(row_ends - row_counts, row_of)
+    within = ranks - row_starts                            # 1-based in-row rank
+    rows = _sorted_gather(m2, row_of).astype(jnp.bfloat16)  # [keep, 128]
+    tri = jnp.tril(jnp.ones((lanes, lanes), jnp.bfloat16))
+    # inclusive in-row prefix on the MXU; counts <= 128 are bf16-exact
+    prefix = jax.lax.dot(rows, tri.T,
+                         preferred_element_type=jnp.float32)
     hit = (prefix >= within[:, None].astype(jnp.float32)) & (rows > 0)
     col = jnp.argmax(hit, axis=1).astype(jnp.int32)
     return jnp.where(valid, row_of * lanes + col, 0)
@@ -213,13 +271,15 @@ def _randomk_indices(key: Array, n: int, keep: int) -> Array:
 def _leaf_sync_randomk(flat: Array, key: Array, keep: int, axis_name: str, world,
                        check: bool = False):
     idx = _randomk_indices(key, flat.shape[0], keep)
-    payload = flat[idx]                                   # [k] — all that travels
+    payload = _sorted_gather(flat, idx)                   # [k] — all that travels
     bits = _payload_bits(payload)
     reduced = jax.lax.psum(payload, axis_name) / world
     # NB: fresh zeros, not zeros_like(flat) — the latter would inherit the
     # device-varying manifest-axes tag of the local gradient and defeat
     # shard_map's replication inference for the psum-reduced result.
-    dense = jnp.zeros(flat.shape, flat.dtype).at[idx].set(reduced)
+    dense = jnp.zeros(flat.shape, flat.dtype).at[idx].set(
+        reduced, indices_are_sorted=True, unique_indices=True,
+        mode="promise_in_bounds")
     agree = None
     if check:
         # `check_reduction` analog: all workers must have selected the SAME
@@ -244,16 +304,11 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world,
     t = kernels.topk_threshold(mag, keep)
     mask = mag >= t
     idx = packed_indices_from_mask(mask, keep)
-    payload = flat[idx]                                   # [k] values + [k] indices travel
+    payload = _sorted_gather(flat, idx)            # [k] values + [k] indices travel
     bits = _payload_bits(payload, idx)
     g_vals = _all_gather(payload, axis_name)       # [W, k]
     g_idx = _all_gather(idx, axis_name)            # [W, k]
-    dense = (
-        jnp.zeros(flat.shape, flat.dtype)
-        .at[g_idx.reshape(-1)]
-        .add(g_vals.reshape(-1))
-        / world
-    )
+    dense = _scatter_combine(flat.shape, flat.dtype, g_idx, g_vals, world)
     # above-threshold survivors beyond `keep` (histogram bin-resolution ties/
     # surplus) are truncated by ascending index; with EF off they are silently
     # dropped — surface the count so callers can see it (ADVICE r2)
@@ -317,18 +372,16 @@ def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
     scores = compressors.blocktopk_scores(flat, block_size)
     t = kernels.topk_threshold(scores, keep_blocks)
     bidx = packed_indices_from_mask(scores >= t, keep_blocks)
-    payload = g2[bidx]                         # [kb, bs] contiguous rows
+    payload = _sorted_gather(g2, bidx)         # [kb, bs] contiguous rows
     bits = _payload_bits(payload, bidx)
     g_vals = _all_gather(payload, axis_name)   # [W, kb, bs]
     g_idx = _all_gather(bidx, axis_name)       # [W, kb]
-    dense2 = (
-        jnp.zeros(g2.shape, flat.dtype)
-        .at[g_idx.reshape(-1)]
-        .add(g_vals.reshape(-1, block_size))
-        / world
-    )
+    dense2 = _scatter_combine(g2.shape, flat.dtype, g_idx, g_vals, world,
+                              block_size=block_size)
     dense = dense2.reshape(-1)[:n]
-    new_ef = g2.at[bidx].set(0.0).reshape(-1)[:n] if want_ef else None
+    new_ef = (g2.at[bidx].set(0.0, indices_are_sorted=True,
+                              unique_indices=True, mode="promise_in_bounds")
+              .reshape(-1)[:n] if want_ef else None)
     return dense, new_ef, bits
 
 
@@ -527,8 +580,12 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         # EF residual = the coordinates that did NOT travel; zeroing the sent
         # ones in place of building a dense local reconstruction saves a full
         # scatter + elementwise pass at model scale.  EF with quantizers is
-        # rejected at build time, so ef_flat != None implies a sparsifier.
-        new_ef = acc.at[idx].set(0) if ef_flat is not None else None
+        # rejected at build time, so ef_flat != None implies a sparsifier —
+        # and sparsifier idx is ascending-unique (packed_indices_from_mask).
+        new_ef = (acc.at[idx].set(0, indices_are_sorted=True,
+                                  unique_indices=True,
+                                  mode="promise_in_bounds")
+                  if ef_flat is not None else None)
         return dense, new_ef, float(keep), bits, agree, None
 
     def sync(grads: Any, ef: Any, key: Array) -> Tuple[Any, Any, Dict[str, Array]]:
