@@ -52,7 +52,9 @@ MANIFEST = {
     },
     "imagenet": {
         "layout": "ImageFolder: <data_dir>/train/<wnid>/*.JPEG, "
-                  "<data_dir>/val/<wnid>/*.JPEG (1000 wnid dirs)",
+                  "<data_dir>/validation/<wnid>/*.JPEG (1000 wnid dirs — "
+                  "'validation', matching the reference's fast-imagenet "
+                  "layout and harness/imagenet.py)",
         "loader": "tpu_compressed_dp.data.imagenet.ImageFolder (+ persisted "
                   "aspect-ratio index for rect-val)",
         "protocol": "ResNet-50 progressive 128->224->288 phase schedule "
@@ -70,7 +72,9 @@ def detect_cifar(data_dir: str) -> bool:
 
 
 def detect_imagenet(data_dir: str) -> bool:
-    t, v = os.path.join(data_dir, "train"), os.path.join(data_dir, "val")
+    # the harness loads <data_dir>/validation (reference fast-imagenet layout)
+    t = os.path.join(data_dir, "train")
+    v = os.path.join(data_dir, "validation")
     if not (os.path.isdir(t) and os.path.isdir(v)):
         return False
     classes = [x for x in os.listdir(t) if os.path.isdir(os.path.join(t, x))]

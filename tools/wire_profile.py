@@ -55,19 +55,21 @@ def _stage_chain(upto: str, n: int, keep: int, axis_name: str = "data"):
         out = out + jnp.sum(idx[:8].astype(jnp.float32))
         if upto == "pack":
             return out
-        payload = flat[idx]
+        payload = wire._sorted_gather(flat, idx)
         out = out + jnp.sum(payload[:8])
         if upto == "gather":
             return out
         world = jax.lax.psum(1, axis_name)
         g_vals = wire._all_gather(payload, axis_name)
         g_idx = wire._all_gather(idx, axis_name)
-        dense = (jnp.zeros(flat.shape, flat.dtype)
-                 .at[g_idx.reshape(-1)].add(g_vals.reshape(-1)) / world)
+        dense = wire._scatter_combine(flat.shape, flat.dtype, g_idx, g_vals,
+                                      world)
         out = out + jnp.sum(dense[:8])
         if upto == "combine":
             return out
-        new_ef = flat.at[idx].set(0)
+        new_ef = flat.at[idx].set(0, indices_are_sorted=True,
+                                  unique_indices=True,
+                                  mode="promise_in_bounds")
         out = out + jnp.sum(new_ef[:8])
         return out
 
@@ -75,7 +77,9 @@ def _stage_chain(upto: str, n: int, keep: int, axis_name: str = "data"):
 
 
 def _pack_sub_chain(upto: str, n: int, keep: int):
-    """Sub-stages of packed_indices_from_mask, cumulative from threshold."""
+    """Sub-stages of the SHIPPED packed_indices_from_mask (pack v2, r5:
+    one fused row-starts gather + bf16 MXU tri-matmul), cumulative from the
+    threshold rung.  Mirrors ops/wire.py — update both together."""
 
     def chain(flat: jax.Array):
         lanes = 128
@@ -102,18 +106,18 @@ def _pack_sub_chain(upto: str, n: int, keep: int):
         if upto == "p_rowof":
             return out
         ranks = jnp.arange(1, keep + 1, dtype=jnp.int32)
-        row_starts = wire._sorted_gather(row_ends, row_of) - wire._sorted_gather(
-            row_counts, row_of)
+        row_starts = wire._sorted_gather(row_ends - row_counts, row_of)
         within = ranks - row_starts
         out = out + jnp.sum(within[:8].astype(jnp.float32))
-        if upto == "p_smallgather":
+        if upto == "p_startsgather":
             return out
-        rows = wire._sorted_gather(m2, row_of).astype(jnp.float32)
-        out = out + jnp.sum(rows[:8])
+        rows = wire._sorted_gather(m2, row_of).astype(jnp.bfloat16)
+        out = out + jnp.sum(rows[:8].astype(jnp.float32))
         if upto == "p_rowgather":
             return out
-        tri = jnp.tril(jnp.ones((lanes, lanes), jnp.float32))
-        prefix = rows @ tri.T
+        tri = jnp.tril(jnp.ones((lanes, lanes), jnp.bfloat16))
+        prefix = jax.lax.dot(rows, tri.T,
+                             preferred_element_type=jnp.float32)
         hit = (prefix >= within[:, None].astype(jnp.float32)) & (rows > 0)
         col = jnp.argmax(hit, axis=1).astype(jnp.int32)
         idx = jnp.where(valid, row_of * lanes + col, 0)
@@ -122,7 +126,7 @@ def _pack_sub_chain(upto: str, n: int, keep: int):
     return chain
 
 
-PACK_SUBS = ["p_rowcounts", "p_hist", "p_rowof", "p_smallgather",
+PACK_SUBS = ["p_rowcounts", "p_hist", "p_rowof", "p_startsgather",
              "p_rowgather", "p_matmul"]
 
 

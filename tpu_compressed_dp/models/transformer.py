@@ -343,19 +343,31 @@ def apply_llama(
     return out
 
 
-# Fused head+xent is OPT-IN (TPU_CDP_FUSED_XENT=1): measured on chip at the
-# 125M / 32k-vocab / seq-1024 config it is ~5% SLOWER than the unfused chain
-# (115.3k vs 120.8k tok/s; chunk 8192 worse at 111.7k) — XLA fuses the
-# one-shot logits+softmax-xent well and the scan adds recompute.  Its value
-# is PEAK MEMORY: the [N, V] logits and their AD saves never materialise,
-# which is what matters at 100k+-vocab stretch configs where the logits
-# buffer rivals the weights.  Numerics: slightly MORE precise than the
-# unfused path at bf16 (fp32 logits inside the scan).
-_FUSED_XENT = os.environ.get("TPU_CDP_FUSED_XENT", "0") == "1"
+# Fused head+xent defaults by SHAPE (r5).  Measured on chip:
+#   * 125M / 32k vocab / seq 1024 (logits 0.5 GB): ~5% SLOWER than the
+#     unfused chain (115.3k vs 120.8k tok/s) — XLA fuses the one-shot
+#     logits+softmax-xent well and the scan adds recompute;
+#   * llama3_8b shapes, 2 layers / 128k vocab / seq 8192 (logits 2.1 GB):
+#     the unfused chain needs 21.9 GB HBM (OOM on a 16 GB v5e) while the
+#     fused path runs at 14.4k tok/s / MFU 0.71 — the [N, V] logits and
+#     AD's saved softmax inputs never materialise.
+# So: auto-enable when the bf16 logits buffer would exceed 1 GiB (the
+# crossover sits well below the OOM cliff and above the 5%-regret regime);
+# TPU_CDP_FUSED_XENT=1/0 forces either way.  Numerics: slightly MORE
+# precise than the unfused path at bf16 (fp32 logits inside the scan).
+_FUSED_XENT = os.environ.get("TPU_CDP_FUSED_XENT", "")
+_FUSED_XENT_AUTO_BYTES = 1 << 30
 
 
-def use_fused_head_xent() -> bool:
-    return _FUSED_XENT
+def use_fused_head_xent(n_tokens: int = 0, vocab: int = 0) -> bool:
+    """Whether the LM loss should take the fused chunked-logsumexp path.
+
+    ``n_tokens``/``vocab`` are the per-worker logits dimensions at the call
+    site (0 = unknown: auto resolves to off, preserving the pre-r5
+    default for callers that cannot size the buffer)."""
+    if _FUSED_XENT in ("0", "1"):
+        return _FUSED_XENT == "1"
+    return n_tokens * vocab * 2 > _FUSED_XENT_AUTO_BYTES
 
 
 def _fhx_chunks(v_local: int, chunk: int):

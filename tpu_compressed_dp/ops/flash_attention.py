@@ -144,6 +144,33 @@ def _dq_kernel(scale: float, blk_q: int, blk_k: int, n_k: int, d: int,
     dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
+def _dkv_block_math(scale, blk_q, blk_k, d, kj, qi, q, dop, k, v,
+                    dk_acc, dv_acc):
+    """One (q block) x (kv block) accumulation of dk/dv — shared by the
+    VMEM-resident and the HBM-streamed dkv kernels."""
+    d_pad = k.shape[-1]
+    do = jnp.concatenate(
+        [dop[:, :d], jnp.zeros((blk_q, d_pad - d), dop.dtype)],
+        axis=1).astype(jnp.float32) if d_pad > d else dop[:, :d].astype(jnp.float32)
+    delta = dop[:, d:d + 1].astype(jnp.float32)
+    lse = dop[:, d + 1:d + 2].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    p = jnp.where(_causal_pos(qi, kj, blk_q, blk_k),
+                  jnp.exp(s - lse), 0.0)
+    dv_acc[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dk_acc[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def _dkv_kernel(scale: float, blk_q: int, blk_k: int, n_q: int, d: int,
                 q_ref, k_ref, v_ref, dop_ref, dk_ref, dv_ref,
                 dk_acc, dv_acc):
@@ -156,27 +183,8 @@ def _dkv_kernel(scale: float, blk_q: int, blk_k: int, n_q: int, d: int,
     def body(qi, _):
         q = q_ref[0, pl.ds(qi * blk_q, blk_q)]
         dop = dop_ref[0, pl.ds(qi * blk_q, blk_q)]
-        d_pad = k.shape[-1]
-        do = jnp.concatenate(
-            [dop[:, :d], jnp.zeros((blk_q, d_pad - d), dop.dtype)],
-            axis=1).astype(jnp.float32) if d_pad > d else dop[:, :d].astype(jnp.float32)
-        delta = dop[:, d:d + 1].astype(jnp.float32)
-        lse = dop[:, d + 1:d + 2].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        p = jnp.where(_causal_pos(qi, kj, blk_q, blk_k),
-                      jnp.exp(s - lse), 0.0)
-        dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        dk_acc[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _dkv_block_math(scale, blk_q, blk_k, d, kj, qi, q, dop, k, v,
+                        dk_acc, dv_acc)
         return 0
 
     # q blocks qi >= kj*blk_k // blk_q can contain positions >= this kv block
@@ -186,8 +194,65 @@ def _dkv_kernel(scale: float, blk_q: int, blk_k: int, n_q: int, d: int,
     dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _dkv_kernel_streamed(scale: float, blk_q: int, blk_k: int, n_q: int,
+                         d: int, q_hbm, k_ref, v_ref, dop_hbm,
+                         dk_ref, dv_ref, dk_acc, dv_acc,
+                         q_buf, dop_buf, q_sem, dop_sem):
+    """dkv with the full-T operands (Q and the packed cotangent) left in
+    HBM and double-buffered per q-block via explicit DMA.  At T=8192/d=128
+    the VMEM-resident form's q (bf16, 2 MB) + packed f32 cotangent (8 MB),
+    Mosaic-double-buffered, blow the 16 MB scoped-vmem ceiling (measured
+    17.5 MB, r5); streaming keeps residency at 2 q-blocks + 2 dop-blocks
+    (~1 MB) regardless of T, so long single-chip sequences are bounded by
+    HBM, not scoped VMEM."""
+    bh = pl.program_id(0)
+    kj = pl.program_id(1)
+    dk_acc[:] = jnp.zeros_like(dk_acc)
+    dv_acc[:] = jnp.zeros_like(dv_acc)
+    k = k_ref[0]
+    v = v_ref[0]
+
+    def q_dma(qi, slot):
+        return pltpu.make_async_copy(
+            q_hbm.at[bh, pl.ds(qi * blk_q, blk_q)], q_buf.at[slot],
+            q_sem.at[slot])
+
+    def dop_dma(qi, slot):
+        return pltpu.make_async_copy(
+            dop_hbm.at[bh, pl.ds(qi * blk_q, blk_q)], dop_buf.at[slot],
+            dop_sem.at[slot])
+
+    first = kj * blk_k // blk_q
+    q_dma(first, jax.lax.rem(first, 2)).start()
+    dop_dma(first, jax.lax.rem(first, 2)).start()
+
+    def body(qi, _):
+        slot = jax.lax.rem(qi, 2)
+        nxt = jax.lax.rem(qi + 1, 2)
+
+        @pl.when(qi + 1 < n_q)
+        def _():
+            q_dma(qi + 1, nxt).start()
+            dop_dma(qi + 1, nxt).start()
+
+        q_dma(qi, slot).wait()
+        dop_dma(qi, slot).wait()
+        _dkv_block_math(scale, blk_q, blk_k, d, kj, qi, q_buf[slot],
+                        dop_buf[slot], k, v, dk_acc, dv_acc)
+        return 0
+
+    jax.lax.fori_loop(first, n_q, body, 0)
+    dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _pick_blocks(t: int) -> tuple:
-    bq = min(512, t)
+    # smaller streamed blocks at long T: the full-T resident operands (K/V in
+    # the dq kernel; Q + packed cotangent in dkv) grow with T and the dkv
+    # kernel sits within ~1.5 MB of the 16 MB scoped-vmem ceiling at T=8192 —
+    # halving the block buffers buys that margin (r5; grid-step overhead is
+    # amortised by the larger per-step loop trip count at these T)
+    bq = min(256 if t >= 8192 else 512, t)
     while t % bq:
         bq //= 2
     return bq, bq
@@ -258,17 +323,31 @@ def _bwd(q, k, v, dop, scale, blk, interpret, out_dtype, d):
         scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32)],
         interpret=interpret,
     )(qs, ks, vs, dops)
+    kv_block = pl.BlockSpec((1, bk, d_pad), lambda bh, kj: (bh, kj, 0),
+                            memory_space=pltpu.VMEM)
+    # Streamed dkv off-interpret: Q and the packed cotangent stay in HBM,
+    # the kernel DMAs per-q-block slices itself (see _dkv_kernel_streamed).
+    # Interpret mode (CPU tests) keeps the VMEM-resident form — identical
+    # math via _dkv_block_math.
+    if interpret:
+        dkv_kernel = functools.partial(_dkv_kernel, scale, bq, bk, t // bq, d)
+        qd_specs = [full(d_pad), kv_block, kv_block, full(ds)]
+        extra_scratch = []
+    else:
+        dkv_kernel = functools.partial(
+            _dkv_kernel_streamed, scale, bq, bk, t // bq, d)
+        qd_specs = [pl.BlockSpec(memory_space=pltpu.ANY), kv_block, kv_block,
+                    pl.BlockSpec(memory_space=pltpu.ANY)]
+        extra_scratch = [
+            pltpu.VMEM((2, bq, d_pad), qs.dtype),
+            pltpu.VMEM((2, bq, ds), dops.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale, bq, bk, t // bq, d),
+        dkv_kernel,
         grid=(b * h, t // bk),
-        in_specs=[
-            full(d_pad),
-            pl.BlockSpec((1, bk, d_pad), lambda bh, kj: (bh, kj, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_pad), lambda bh, kj: (bh, kj, 0),
-                         memory_space=pltpu.VMEM),
-            full(ds),
-        ],
+        in_specs=[qd_specs[0], qd_specs[1], qd_specs[2], qd_specs[3]],
         out_specs=[
             pl.BlockSpec((1, bk, d_pad), lambda bh, kj: (bh, kj, 0),
                          memory_space=pltpu.VMEM),
@@ -282,7 +361,7 @@ def _bwd(q, k, v, dop, scale, blk, interpret, out_dtype, d):
         scratch_shapes=[
             pltpu.VMEM((bk, d_pad), jnp.float32),
             pltpu.VMEM((bk, d_pad), jnp.float32),
-        ],
+        ] + extra_scratch,
         interpret=interpret,
     )(qs, ks, vs, dops)
     rs = lambda x: x.reshape(b, h, t, d_pad)

@@ -54,7 +54,7 @@ _NEG_INF = -1e30
 _FUSED_ATTN = os.environ.get("TPU_CDP_FUSED_ATTN", "1") != "0"
 
 
-def use_fused_attention(q_shape, k_shape) -> bool:
+def use_fused_attention(q_shape, k_shape, itemsize: int = 2) -> bool:
     """True when the single-block causal path should hit the fused kernel
     (:mod:`tpu_compressed_dp.ops.flash_attention`): TPU backend, seq a lane
     multiple, head_dim MXU-friendly, K/V small enough to stream through
@@ -68,13 +68,19 @@ def use_fused_attention(q_shape, k_shape) -> bool:
         return False
     b, h, t, d = q_shape
     d_pad = d + (-d) % 128
-    # lanes of the packed cotangent (do | delta | lse) in the backward
-    d_store = d_pad if d_pad - d >= 2 else d_pad + 128
-    # worst resident set is the dkv backward: full K + V (forward holds the
-    # same) PLUS full Q and the packed cotangent, all fp32 in VMEM
-    resident = t * (2 * d_pad + d_pad + d_store) * 4
+    # Binding constraint since the r5 streamed dkv backward (which keeps its
+    # full-T operands in HBM): the fwd/dq kernels' Mosaic-managed full-T
+    # K + V blocks, held at input dtype (bf16 in practice) and
+    # double-buffered, must fit the TPU's ~16 MB scoped-vmem ceiling with
+    # room for the streamed q/do blocks.  Cap the single-buffered K+V set
+    # at 4 MB (= 8 MB doubled + block buffers, comfortably under 16 MB):
+    # admits the chip-verified T=8192 at d=128 exactly; T=16384 (8 MB
+    # single, ~18+ doubled) would hit the same scoped-vmem wall the r5 dkv
+    # fix removed — long-context's designed path is the seq-axis ring
+    # sharding T_local below this gate.
+    resident = t * 2 * d_pad * itemsize   # K + V at input dtype
     return (t == k_shape[2] and t >= 128 and t % 128 == 0 and d % 64 == 0
-            and resident <= 10 * 1024 * 1024)
+            and resident <= 4 * 1024 * 1024)
 
 
 def _fused_causal(q: Array, k: Array, v: Array, scale: float) -> Array:
@@ -139,7 +145,7 @@ def ring_attention(
         # single-block case and must hit the same fused path
         ring = jax.lax.psum(1, axis_name)
         my = jax.lax.axis_index(axis_name)
-    if ring == 1 and use_fused_attention(q.shape, k.shape):
+    if ring == 1 and use_fused_attention(q.shape, k.shape, q.dtype.itemsize):
         return _fused_causal(q, k, v, scale)
 
     q_pos = my * t_local + jnp.arange(t_local)

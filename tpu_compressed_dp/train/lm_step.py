@@ -145,7 +145,9 @@ def make_lm_train_step(
         comp_key = jax.random.fold_in(state.rng, state.step)
 
         def loss_fn(params):
-            if use_fused_head_xent():
+            # per-worker logits buffer: local tokens x vocab shard (V/tp)
+            if use_fused_head_xent(x.shape[0] * x.shape[1],
+                                   cfg.vocab_size // mesh.shape["tensor"]):
                 # head matmul + softmax-xent fused through a chunked running
                 # logsumexp: the [B,T,V] logits (and AD's saved softmax
                 # inputs) never materialise in HBM
