@@ -428,6 +428,30 @@ def test_packed_indices_underfull_mask_degrades_benignly():
         np.asarray(idx), np.asarray(jnp.nonzero(mask, size=8, fill_value=0)[0]))
 
 
+@pytest.mark.quick
+def test_packed_indices_exact_oracle_across_shapes():
+    """Pack v2 (r5: fused row-starts gather + bf16 tri-matmul) must stay
+    bit-identical to ``np.flatnonzero(mask)[:keep]`` padded with 0 — the
+    oracle the round-5 rewrite was verified against — across row-boundary
+    shapes, densities, and keep <, ==, > count."""
+    from tpu_compressed_dp.ops.wire import packed_indices_from_mask
+
+    rng = np.random.default_rng(7)
+    for n in (5, 127, 128, 129, 1000, 4096):
+        for frac in (0.02, 0.3, 0.9):
+            mask = rng.random(n) < frac
+            count = int(mask.sum())
+            for keep in {1, max(1, count // 2), max(count, 1),
+                         min(count + 3, n)}:
+                got = np.asarray(
+                    packed_indices_from_mask(jnp.asarray(mask), int(keep)))
+                want = np.flatnonzero(mask)[:keep]
+                want = np.pad(want, (0, keep - len(want)))
+                np.testing.assert_array_equal(got, want,
+                                              err_msg=f"n={n} frac={frac} "
+                                                      f"keep={keep}")
+
+
 class TestBlockTopKWire:
     """Net-new blocktopk: whole contiguous blocks travel as lane-aligned rows."""
 

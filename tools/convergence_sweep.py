@@ -148,6 +148,14 @@ GRID = [
     ("adaptive-lw-EF-40ep", ["--compress", "layerwise", "--method",
                              "adaptive_threshold", "--error_feedback",
                              "--epochs", "40"]),
+    # r5: small-block Block-Top-K — the granularity<->accuracy frontier
+    # companion to the throughput bs-sweep (benchmarks/wire_wall_r5.txt):
+    # does bs=64 selection (the 1.64x-dense wire point) converge like
+    # element Top-K (0.9619) or cost accuracy?
+    ("blocktopk-em-1%-wire-bs64", ["--compress", "entiremodel", "--method",
+                                   "blocktopk", "--ratio", "0.01",
+                                   "--block_size", "64",
+                                   "--error_feedback", "--mode", "wire"]),
 ]
 
 COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",
