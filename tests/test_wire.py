@@ -526,6 +526,29 @@ class TestBlockTopKWire:
         np.testing.assert_allclose(np.asarray(ef1["small"]), np.zeros(10))
 
 
+
+    def test_small_bs_ef_immune_to_inf_in_sent_block(self, mesh8):
+        """Covering-row EF (r5): a sent block containing inf must leave the
+        residual finite and zeroed there — a scatter-multiply formulation
+        would produce inf*0 = NaN and poison error feedback permanently
+        (caught in r5 review; the mask-accumulate + where form is immune)."""
+        from tpu_compressed_dp.ops import wire as wire_mod
+
+        def f(flat):
+            world = jax.lax.psum(1, "data")
+            dense, ef, bits = wire_mod._leaf_sync_blocktopk(
+                flat[0], 2, 8, "data", world, True)
+            return dense, ef[None]
+
+        g = np.random.default_rng(0).standard_normal(256).astype(np.float32)
+        g[5] = np.inf
+        gb = jnp.broadcast_to(jnp.asarray(g), (8, 256))
+        dense, ef = shard_map(f, mesh=mesh8, in_specs=P("data"),
+                              out_specs=(P(), P("data")))(gb)
+        ef0 = np.asarray(ef)[0]
+        assert np.isfinite(ef0).all()
+        assert (ef0[0:8] == 0).all()
+
 class TestBucketedWire:
     def test_bucketed_wire_matches_simulate(self, mesh8):
         # multi-leaf buckets through the wire path: same grouping and keys as

@@ -156,6 +156,12 @@ GRID = [
                                    "blocktopk", "--ratio", "0.01",
                                    "--block_size", "64",
                                    "--error_feedback", "--mode", "wire"]),
+    # bs=8: near-element selection granularity at ~1.5x-dense wire speed
+    # (the covering-row payload path, r5)
+    ("blocktopk-em-1%-wire-bs8", ["--compress", "entiremodel", "--method",
+                                  "blocktopk", "--ratio", "0.01",
+                                  "--block_size", "8",
+                                  "--error_feedback", "--mode", "wire"]),
 ]
 
 COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",

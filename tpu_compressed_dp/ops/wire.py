@@ -368,10 +368,13 @@ def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
     from tpu_compressed_dp.ops import kernels
 
     n = flat.shape[0]
-    g2 = compressors.blocktopk_blocks(flat, block_size)
     scores = compressors.blocktopk_scores(flat, block_size)
     t = kernels.topk_threshold(scores, keep_blocks)
     bidx = packed_indices_from_mask(scores >= t, keep_blocks)
+    if block_size < 128 and 128 % block_size == 0:
+        return _blocktopk_small_bs(flat, bidx, block_size, axis_name, world,
+                                   want_ef)
+    g2 = compressors.blocktopk_blocks(flat, block_size)
     payload = _sorted_gather(g2, bidx)         # [kb, bs] contiguous rows
     bits = _payload_bits(payload, bidx)
     g_vals = _all_gather(payload, axis_name)   # [W, kb, bs]
@@ -382,6 +385,83 @@ def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
     new_ef = (g2.at[bidx].set(0.0, indices_are_sorted=True,
                               unique_indices=True, mode="promise_in_bounds")
               .reshape(-1)[:n] if want_ef else None)
+    return dense, new_ef, bits
+
+
+def _blocktopk_small_bs(flat: Array, bidx: Array, block_size: int,
+                        axis_name: str, world, want_ef: bool):
+    """Block-Top-K wire sync for sub-128-lane blocks via COVERING rows.
+
+    A ``[nb, block_size]`` view pads every row to the 128-lane register
+    width, so gathering/scattering ``block_size``-wide rows at bs=8 wastes
+    16x the memory machinery (measured 36 ms of "rest" at the 125M/1%
+    config, benchmarks/wire_wall_r5.txt).  Instead keep the natural
+    ``[m, 128]`` layout and touch only full cache-line rows:
+
+      * payload gather: fetch each selected block's COVERING 128-lane row
+        (one full-line access), then select its ``128/bs`` sub-block in
+        registers (jnp.where + sum over the sub-block axis — `where`, not
+        multiply-by-mask, so inf/nan gradients in unselected blocks cannot
+        poison the selection);
+      * scatter-add reconstruction: expand each worker's ``[kb, bs]``
+        payload into zeros-padded covering rows and scatter-add full rows
+        (duplicate row ids — two selected blocks sharing a row — are
+        legal for add);
+      * EF: scatter-MULTIPLY the covering rows by a keep-mask (commutative,
+        so duplicate rows compose correctly).
+
+    The wire format and billing are unchanged: ``[kb, bs]`` values +
+    ``[kb]`` indices travel, exactly like the wide-block path.
+    """
+    n = flat.shape[0]
+    per = 128 // block_size
+    pad = (-n) % 128
+    g128 = jnp.pad(flat, (0, pad)).reshape(-1, 128)       # [m, 128]
+    kb = bidx.shape[0]
+    rowid = bidx // per                                   # sorted, not unique
+    sub = bidx % per
+    rows = _sorted_gather(g128, rowid)                    # [kb, 128] full lines
+    sel = (jnp.arange(per, dtype=jnp.int32)[None, :] == sub[:, None])
+    payload = jnp.sum(
+        jnp.where(sel[:, :, None], rows.reshape(kb, per, block_size), 0.0),
+        axis=1)                                           # [kb, bs]
+    bits = _payload_bits(payload, bidx)
+    g_vals = _all_gather(payload, axis_name)              # [W, kb, bs]
+    g_idx = _all_gather(bidx, axis_name)                  # [W, kb]
+    W = g_idx.shape[0]
+
+    def expand(idx_row, vals_row):
+        s = (jnp.arange(per, dtype=jnp.int32)[None, :]
+             == (idx_row % per)[:, None])
+        return jnp.where(s[:, :, None], vals_row[:, None, :],
+                         0.0).reshape(-1, 128)
+
+    dense128 = jnp.zeros(g128.shape, flat.dtype)
+    if W <= 16:
+        for w in range(W):
+            dense128 = dense128.at[g_idx[w] // per].add(
+                expand(g_idx[w], g_vals[w]), indices_are_sorted=True,
+                mode="promise_in_bounds")
+    else:
+        # compile-size guard (same rationale as _scatter_combine): one fused
+        # unhinted scatter over all workers' expanded rows
+        dense128 = dense128.at[(g_idx // per).reshape(-1)].add(
+            expand(g_idx.reshape(-1), g_vals.reshape(-1, block_size)))
+    dense = (dense128 / world).reshape(-1)[:n]
+    new_ef = None
+    if want_ef:
+        # EF = zero exactly the sent sub-blocks.  A direct scatter-multiply
+        # of g128 by a 0/1 mask would turn a sent inf into inf*0 = NaN and
+        # poison the residual (the wide path's set(0.0) is immune) — so
+        # accumulate the mask separately (finite 0/1 values compose under
+        # duplicate covering rows) and apply it with where.
+        keep_mask = jnp.broadcast_to(
+            ~sel[:, :, None], (kb, per, block_size)).astype(
+                jnp.uint8).reshape(kb, 128)
+        maskarr = jnp.ones(g128.shape, jnp.uint8).at[rowid].multiply(
+            keep_mask, indices_are_sorted=True, mode="promise_in_bounds")
+        new_ef = jnp.where(maskarr.astype(bool), g128,
+                           0.0).reshape(-1)[:n]
     return dense, new_ef, bits
 
 
