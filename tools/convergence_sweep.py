@@ -162,6 +162,16 @@ GRID = [
                                   "blocktopk", "--ratio", "0.01",
                                   "--block_size", "8",
                                   "--error_feedback", "--mode", "wire"]),
+    # the frontier's hardest point: k=0.1% at 8-element blocks, under the
+    # recipe that closed element Top-K k=0.1% (step peak 0.04, 16-ep
+    # geometric warm-up, both clips, 60 epochs — convergence_r4.tsv)
+    ("blocktopk-em-0.1%-wire-bs8-mom9", [
+        "--compress", "entiremodel", "--method", "blocktopk",
+        "--ratio", "0.001", "--block_size", "8",
+        "--error_feedback", "--mode", "wire",
+        "--lr_schedule", "step", "--peak_lr", "0.04",
+        "--epochs", "60", "--ratio_warmup_epochs", "16",
+        "--clip_norm", "1.0", "--clip_sent_norm", "1.0"]),
 ]
 
 COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",
