@@ -37,7 +37,10 @@ params, stats = init_model(module, jax.random.key(0),
 
 # 3. the compression surface: method x granularity x payload mode x EF
 comp = CompressionConfig(
-    method="topk",            # topk | randomk | thresholdv | terngrad | qsgd ...
+    method="topk",            # topk | randomk | thresholdv | terngrad | qsgd
+                              # | powersgd (stateful: also pass
+                              # comp=init_comp_state(params, comp, ndev)
+                              # to TrainState.create)
     granularity="layerwise",  # or "entiremodel"
     mode="simulate",          # or "wire" for genuinely sparse payloads
     ratio=0.01,               # keep 1% of coordinates

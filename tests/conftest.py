@@ -29,6 +29,14 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_collection_modifyitems(config, items):
+    """Run the ``imports_smoke`` tests first: a broken import then fails in
+    seconds as one named test instead of as 20 opaque collection errors at
+    the end of the run."""
+    items.sort(key=lambda it: 0 if it.get_closest_marker("imports_smoke")
+               else 1)
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from tpu_compressed_dp.parallel.mesh import make_data_mesh

@@ -5,31 +5,36 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from tpu_compressed_dp.compat import shard_map
 
 from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state, make_grad_sync
 
 
-def run_sync(mesh, cfg, grads_per_dev, ef=None, seed=0):
+def run_sync(mesh, cfg, grads_per_dev, ef=None, seed=0, comp=None):
     """grads_per_dev: pytree whose leaves have leading dim 8 (one slice per device)."""
+    from tpu_compressed_dp.parallel.dp import init_comp_state
+
     sync = make_grad_sync(cfg, "data")
     if ef is None:
         ef = init_ef_state(jax.tree.map(lambda g: g[0], grads_per_dev), cfg)
+    if comp is None:
+        comp = init_comp_state(jax.tree.map(lambda g: g[0], grads_per_dev), cfg)
 
-    def f(g, e):
-        out, new_ef, stats = sync(g, e, jax.random.key(seed))
-        return out, new_ef, stats
+    def f(g, e, c):
+        out, new_ef, new_comp, stats = sync(g, e, c, jax.random.key(seed))
+        return out, new_ef, new_comp, stats
 
     shard_spec = jax.tree.map(lambda _: P("data"), grads_per_dev)
     # one slice per device in, replicated grads out
     fn = shard_map(
-        lambda g, e: f(jax.tree.map(lambda x: x[0], g), e),
+        lambda g, e, c: f(jax.tree.map(lambda x: x[0], g), e, c),
         mesh=mesh,
-        in_specs=(shard_spec, P()),
-        out_specs=(P(), P(), P()),
+        in_specs=(shard_spec, P(), P()),
+        out_specs=(P(), P(), P(), P()),
         check_vma=False,
     )
-    return fn(grads_per_dev, ef)
+    out, new_ef, new_comp, stats = fn(grads_per_dev, ef, comp)
+    return out, new_ef, stats
 
 
 def make_grads(shape_leading=8, n=64, seed=0):

@@ -14,6 +14,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from tpu_compressed_dp import compat
 from tpu_compressed_dp.ops import compressors, kernels
 
 
@@ -39,6 +40,7 @@ class TestTopkThreshold:
         np.testing.assert_array_equal(np.asarray(mag >= t), np.asarray(mag >= exact))
         assert int(jnp.sum(mag >= t)) == keep
 
+    @pytest.mark.slow  # ~9 s each on the 1-core host (multi-MB interpret runs)
     @pytest.mark.parametrize("keep_frac", [0.01, 0.1])
     def test_sampled_init_large_n(self, keep_frac):
         # large n + moderate keep engages the sampled-init fast path (slab
@@ -62,6 +64,7 @@ class TestTopkThreshold:
         t = kernels._topk_threshold_pallas(mag, keep, interpret=True)
         assert int(jnp.sum(mag >= t)) == keep
 
+    @pytest.mark.slow  # ~9 s on the 1-core host
     def test_sampled_init_adversarial_layout_keeps_guarantee(self):
         # the slab sample reads the first 128 lanes of each C-block (C=4096
         # for this n/keep); hide MORE than `keep` spikes in the unsampled
@@ -107,6 +110,10 @@ class TestTopkThreshold:
         assert int(jnp.count_nonzero(out)) == keep
 
 
+@pytest.mark.skipif(
+    not compat.HAS_TPU_INTERPRET,
+    reason="quantizer kernels draw from the TPU hardware PRNG; the stock "
+           "HLO interpreter on this jax release has no prng_seed lowering")
 class TestQuantKernels:
     """Interpret-mode PRNG is a zero stub on CPU (dither u == 0), so these
     cover everything EXCEPT the dither draw: with u=0 QSGD degenerates to

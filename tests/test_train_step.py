@@ -12,7 +12,8 @@ import numpy as np
 import pytest
 
 from tpu_compressed_dp.models.common import init_model, make_apply_fn
-from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
+from tpu_compressed_dp.parallel.dp import (CompressionConfig, init_comp_state,
+                                           init_ef_state)
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.schedules import piecewise_linear
 from tpu_compressed_dp.train.state import TrainState
@@ -51,7 +52,9 @@ def build(mesh, module, cfg, *, bs=64, lr=0.05, momentum=0.9, ef=False):
     params, stats = init_model(module, jax.random.key(0), jnp.zeros((1, 8, 8, 3), jnp.float32))
     opt = SGD(lr=lr, momentum=momentum, nesterov=True, weight_decay=1e-4)
     ef_state = init_ef_state(params, cfg, num_devices=mesh.shape["data"])
-    state = TrainState.create(params, stats, opt.init(params), ef_state, jax.random.key(1))
+    comp_state = init_comp_state(params, cfg, num_devices=mesh.shape["data"])
+    state = TrainState.create(params, stats, opt.init(params), ef_state,
+                              jax.random.key(1), comp=comp_state)
     apply_fn = make_apply_fn(module)
     step = make_train_step(apply_fn, opt, cfg, mesh, grad_scale=1.0, donate=False)
     ev = make_eval_step(apply_fn, mesh)
@@ -67,6 +70,9 @@ CONFIGS = [
     CompressionConfig(method="terngrad"),
     CompressionConfig(method="adaptive_threshold", granularity="entiremodel"),
     CompressionConfig(method="thresholdv", threshold=1e-4),
+    CompressionConfig(method="powersgd", rank=2, error_feedback=True),
+    CompressionConfig(method="powersgd", rank=4, granularity="entiremodel",
+                      error_feedback=True),
 ]
 
 
@@ -100,6 +106,27 @@ def test_ef_state_threads_through(mesh8):
     state, _ = step(state, batch)
     ef_mag = sum(float(jnp.sum(jnp.abs(l))) for l in jax.tree.leaves(state.ef))
     assert ef_mag > 0
+
+
+def test_comp_state_threads_through(mesh8):
+    """The stateful compressor path end-to-end: TrainState.comp leaves
+    change across a powersgd step (warm-start Q updated in the jitted step)
+    and the transport stats report psum-only traffic."""
+    cfg = CompressionConfig(method="powersgd", rank=2, error_feedback=True)
+    batch = make_batch()
+    state, step, _ = build(mesh8, TinyMLP(), cfg)
+    before = {k: np.asarray(v) for k, v in state.comp.items()}
+    assert before  # TinyMLP's dense kernels are large enough to compress
+    state, metrics = step(state, batch)
+    assert set(state.comp) == set(before)
+    moved = any(not np.array_equal(np.asarray(state.comp[k]), before[k])
+                for k in before)
+    assert moved
+    assert float(metrics["comm/sent_bits_psum"]) > 0
+    assert float(metrics["comm/sent_bits_allgather"]) == 0.0
+    # second step must accept the updated state (stable pytree structure)
+    state, _ = step(state, batch)
+    assert int(state.step) == 2
 
 
 def test_dense_equals_singlehost_sgd(mesh8):

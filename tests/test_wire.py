@@ -11,10 +11,16 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from tpu_compressed_dp.compat import shard_map
 
 from tpu_compressed_dp.ops import wire
 from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state, make_grad_sync
+
+# ~6 min of shard_map compiles on the 1-core CI host — by far the largest
+# module: excluded from the 870 s tier-1 budget (`-m 'not slow'`; the
+# simulate-mode engine keeps tier-1 coverage via test_dp_sync/test_lowrank),
+# runs in the unfiltered suite on real hardware
+pytestmark = pytest.mark.slow
 
 
 def run_sync(mesh, cfg, grads_per_dev, ef=None, seed=0):
@@ -23,7 +29,9 @@ def run_sync(mesh, cfg, grads_per_dev, ef=None, seed=0):
         ef = init_ef_state(jax.tree.map(lambda g: g[0], grads_per_dev), cfg)
 
     def f(g, e):
-        return sync(jax.tree.map(lambda x: x[0], g), e, jax.random.key(seed))
+        out, new_ef, _, stats = sync(
+            jax.tree.map(lambda x: x[0], g), e, (), jax.random.key(seed))
+        return out, new_ef, stats
 
     shard_spec = jax.tree.map(lambda _: P("data"), grads_per_dev)
     fn = shard_map(
@@ -387,7 +395,7 @@ class TestCheckSync:
         sync = make_grad_sync(cfg, "data")
 
         def f(g):
-            return sync({"w": g[0]}, (), key_fn())[2]
+            return sync({"w": g[0]}, (), (), key_fn())[3]
 
         return shard_map(
             f, mesh=mesh8, in_specs=P("data"), out_specs=P(),
@@ -409,7 +417,7 @@ class TestCheckSync:
         sync = make_grad_sync(cfg, "data")
 
         def f(g):
-            stats = sync({"w": g[0]}, (), per_worker_key())[2]
+            stats = sync({"w": g[0]}, (), (), per_worker_key())[3]
             return stats["sync_agree"].reshape(1)
 
         agree = shard_map(f, mesh=mesh8, in_specs=P("data"),

@@ -172,6 +172,22 @@ GRID = [
         "--lr_schedule", "step", "--peak_lr", "0.04",
         "--epochs", "60", "--ratio_warmup_epochs", "16",
         "--clip_norm", "1.0", "--clip_sent_norm", "1.0"]),
+    # --- r6: PowerSGD rank axis (ops/lowrank.py) --------------------------
+    # The low-rank companion to the k-ratio sweeps: r in {1, 2, 4} at
+    # layerwise grouping, EF on (Vogels et al. run PowerSGD with EF always;
+    # the factors are a biased projection, EF is what makes it converge).
+    # Wire cost at r is ~r*(m + n/m)/n of dense — r=1 undercuts even
+    # k=0.1% Top-K while riding the psum ring instead of an all_gather.
+    ("powersgd-lw-r1", ["--compress", "layerwise", "--method", "powersgd",
+                        "--rank", "1", "--error_feedback"]),
+    ("powersgd-lw-r2", ["--compress", "layerwise", "--method", "powersgd",
+                        "--rank", "2", "--error_feedback"]),
+    ("powersgd-lw-r4", ["--compress", "layerwise", "--method", "powersgd",
+                        "--rank", "4", "--error_feedback"]),
+    # entiremodel: one near-square matrix for the whole gradient — the
+    # grouping that maximises the factor payload saving
+    ("powersgd-em-r4", ["--compress", "entiremodel", "--method", "powersgd",
+                        "--rank", "4", "--error_feedback"]),
 ]
 
 COLS = ["label", "method", "ratio", "mode", "epochs", "train_acc", "test_acc",

@@ -64,7 +64,7 @@ def worker(args) -> None:
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from tpu_compressed_dp.compat import shard_map
     from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grad_sync
 
     _, method, mode, extra = next(c for c in CASES if c[0] == args.case)
@@ -82,8 +82,8 @@ def worker(args) -> None:
         # identical key on every rank (the shared-seed contract wire
         # randomk/quantizer dither relies on)
         key = jax.random.key(7)
-        synced, new_ef, stats = sync({"g": g}, {"g": ef} if cfg.error_feedback
-                                     else (), key)
+        synced, new_ef, _, stats = sync(
+            {"g": g}, {"g": ef} if cfg.error_feedback else (), (), key)
         out = synced["g"]
         nef = new_ef["g"] if cfg.error_feedback else ef
         return out, nef, stats

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from tpu_compressed_dp.compat import shard_map
 
 from tpu_compressed_dp.ops import compressors, kernels, wire
 

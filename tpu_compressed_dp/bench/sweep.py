@@ -37,7 +37,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_compressed_dp.models.common import init_model, make_apply_fn
-from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
+from tpu_compressed_dp.ops.compressors import canonical_name
+from tpu_compressed_dp.parallel.dp import (CompressionConfig, init_comp_state,
+                                           init_ef_state)
 from tpu_compressed_dp.parallel.mesh import make_data_mesh
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
@@ -81,6 +83,7 @@ def run_point(
     block_size: int = 256,
     bucket_mb: float = 25.0,
     wire_cap_ratio: float = 0.05,
+    rank: int = 4,
     error_feedback: bool = False,
     batch_size: int = 512,
     image_size: int = 128,
@@ -111,12 +114,13 @@ def run_point(
         method=method, granularity=granularity, mode=mode, ratio=ratio,
         threshold=threshold,
         qstates=qstates, block_size=block_size, bucket_mb=bucket_mb,
-        wire_cap_ratio=wire_cap_ratio,
+        wire_cap_ratio=wire_cap_ratio, rank=rank,
         error_feedback=error_feedback,
     )
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, cfg, ndev),
         jax.random.key(1),
+        comp=init_comp_state(params, cfg, ndev),
     )
     train_step = make_train_step(apply_fn, opt, cfg, mesh, grad_scale=1.0)
 
@@ -157,6 +161,8 @@ def run_point(
         "granularity": granularity,
         "mode": mode,
         "ratio": ratio,
+        **({"rank": rank} if method is not None and
+           canonical_name(method) == "powersgd" else {}),
         "error_feedback": bool(error_feedback),
         "devices": ndev,
         "batch": bs,
@@ -255,21 +261,31 @@ def run_sweep(args) -> List[Dict[str, float]]:
     )
     print(f"# dense baseline: {args.model}", file=sys.stderr)
     emit(run_point(method=None, **{**common, "error_feedback": False}))
-    from tpu_compressed_dp.ops.compressors import canonical_name
 
+    ranks = [int(r) for r in args.ranks.split(",") if r.strip()]
     for method, gran in itertools.product(methods, grans):
-        pts = ratios if method in ("topk", "randomk", "blocktopk") else [None]
+        canon = canonical_name(method)
+        # the sweep axis is method-specific: k-ratios for the sparsifiers,
+        # the low-rank r for powersgd, a single point for everything else
+        if canon in ("topk", "randomk", "blocktopk"):
+            pts = [("ratio", r) for r in ratios]
+        elif canon == "powersgd":
+            pts = [("rank", r) for r in ranks]
+        else:
+            pts = [(None, None)]
         # EF composes with sparsifiers only; quantizers are unbiased with no
         # dropped coordinates (wire mode rejects the combination) — sweep
         # them with EF off instead of crashing a mixed-method grid.
         kw = common
-        if canonical_name(method) in ("terngrad", "qsgd") and args.error_feedback:
+        if canon in ("terngrad", "qsgd") and args.error_feedback:
             kw = {**common, "error_feedback": False}
-        for ratio in pts:
-            label = f"{method}/{gran}" + (f"/k={ratio}" if ratio is not None else "")
+        for axis, val in pts:
+            label = f"{method}/{gran}" + (
+                f"/k={val}" if axis == "ratio"
+                else f"/r={val}" if axis == "rank" else "")
             print(f"# {label}", file=sys.stderr)
             emit(run_point(method=method, granularity=gran,
-                           ratio=ratio if ratio is not None else 0.01, **kw))
+                           **({axis: val} if axis else {}), **kw))
     if args.tsv:
         import os
 
@@ -302,6 +318,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "thresholdv,adaptive_threshold,terngrad,qsgd")
     p.add_argument("--ratios", default="0.001,0.01,0.1",
                    help="k values for topk/blocktopk/randomk (paper: 0.1%%,1%%,10%%)")
+    p.add_argument("--ranks", default="1,2,4",
+                   help="r values for powersgd (its sweep axis instead of k)")
     p.add_argument("--granularities", default="layerwise,entiremodel")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--threshold", type=float, default=1e-3,

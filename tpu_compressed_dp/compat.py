@@ -1,0 +1,139 @@
+"""JAX version-compatibility shims (shard_map, VMA typing, pcast).
+
+The framework targets the modern ``jax.shard_map`` API with varying-manual-axes
+(VMA) typing: replicated inputs are explicitly ``jax.lax.pcast``-ed to
+device-varying before differentiation so the per-worker local gradient — not an
+auto-psummed mean — reaches the compression engine, and Pallas kernels annotate
+``vma=`` on their out shapes so carries typecheck under ``shard_map``.
+
+On older releases (``jax < 0.6``, where ``shard_map`` still lives in
+``jax.experimental`` and VMA typing does not exist) the same semantics are
+recovered with the replication-checking rewrite DISABLED (``check_rep=False``):
+without the rewrite machinery, AD inside ``shard_map`` yields the local
+per-worker gradient for replicated inputs — exactly what the explicit
+pcast-to-varying buys on new JAX — and ``pcast``/``vma=`` degrade to no-ops.
+
+Every module in this package imports ``shard_map``/``pcast``/``typeof``/
+``shape_dtype_struct`` from here instead of from ``jax`` directly; this is the
+single place version detection happens.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["shard_map", "pcast", "typeof", "shape_dtype_struct",
+           "pallas_compiler_params", "pallas_interpret_params",
+           "HAS_NATIVE_SHARD_MAP", "HAS_VMA", "HAS_TPU_INTERPRET",
+           "HAS_CPU_MULTIPROCESS"]
+
+# jax >= 0.6: shard_map is a top-level export with `check_vma` semantics.
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+# VMA typing (jax.typeof(...).vma, lax.pcast, ShapeDtypeStruct(vma=...))
+# arrived with the new shard_map; detect each piece independently so partial
+# backports keep working.
+HAS_VMA = hasattr(jax.lax, "pcast")
+
+def _version_tuple() -> tuple:
+    import re
+
+    # keep each component's leading digits so rc/dev suffixes ("0.6.0rc1")
+    # parse as (0, 6, 0) instead of collapsing to an all-zero version
+    parts = []
+    for x in jax.__version__.split(".")[:3]:
+        m = re.match(r"\d+", x)
+        parts.append(int(m.group()) if m else 0)
+    return tuple(parts)
+
+
+# Cross-process collectives on the CPU backend (the gloo-backed path the
+# 2-process rendezvous tools exercise): 0.4.x raises "Multiprocess
+# computations aren't implemented on the CPU backend".
+HAS_CPU_MULTIPROCESS = _version_tuple() >= (0, 5, 0)
+
+# TPU-semantics Pallas interpreter (pltpu.InterpretParams): required to
+# interpret kernels that draw from the hardware PRNG — the stock HLO
+# interpreter on old releases has no prng_seed/prng_random_bits lowering.
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+
+    HAS_TPU_INTERPRET = hasattr(_pltpu, "InterpretParams")
+except ImportError:  # pragma: no cover
+    HAS_TPU_INTERPRET = False
+
+if not HAS_NATIVE_SHARD_MAP:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: Optional[bool] = None,
+              **kwargs):
+    """``jax.shard_map`` when available, else the ``jax.experimental`` one.
+
+    ``check_vma`` maps to the old API's ``check_rep``.  When the caller does
+    not pass it, old JAX defaults to ``check_rep=False``: the rep-checking
+    rewrite would auto-psum gradients of replicated inputs, defeating the
+    compress-before-reduce design (new JAX expresses the same intent with
+    ``pcast(..., to='varying')``, which is an identity here).
+    """
+    if HAS_NATIVE_SHARD_MAP:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+    return _experimental_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=bool(check_vma) if check_vma is not None else False,
+        **kwargs)
+
+
+def pcast(x: Any, axis_name, *, to: str = "varying") -> Any:
+    """``jax.lax.pcast`` under VMA typing; identity where VMA does not exist
+    (old shard_map with ``check_rep=False`` already treats every value as
+    potentially device-varying, so there is nothing to mark)."""
+    if HAS_VMA:
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x
+
+
+def typeof(x: Any):
+    """``jax.typeof`` when available, else the abstract value.  Callers read
+    ``getattr(typeof(x), 'vma', frozenset())``, which degrades to the empty
+    set (no VMA tracking) on old JAX."""
+    if hasattr(jax, "typeof"):
+        return jax.typeof(x)
+    return jax.core.get_aval(x)
+
+
+def shape_dtype_struct(shape, dtype, *, vma=frozenset()) -> jax.ShapeDtypeStruct:
+    """``jax.ShapeDtypeStruct`` carrying a ``vma`` annotation where supported;
+    the annotation is dropped on old JAX (no VMA typing to satisfy)."""
+    if HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def pallas_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (new name) or ``pltpu.TPUCompilerParams``
+    (old), dropping any field the installed release does not know."""
+    import dataclasses
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    known = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in kwargs.items() if k in known})
+
+
+def pallas_interpret_params():
+    """The TPU-semantics Pallas interpreter (``pltpu.InterpretParams``) where
+    it exists; plain ``interpret=True`` (the stock HLO interpreter) on older
+    releases — whose hardware-PRNG ops are a zero stub, which the quantizer
+    kernel tests account for."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.InterpretParams()
+    except (ImportError, AttributeError):
+        return True

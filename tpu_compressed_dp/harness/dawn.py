@@ -39,7 +39,8 @@ from tpu_compressed_dp.models.common import (
     make_apply_fn,
     make_normalizing_apply_fn,
 )
-from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
+from tpu_compressed_dp.parallel.dp import (CompressionConfig, init_comp_state,
+                                           init_ef_state)
 from tpu_compressed_dp.parallel.mesh import distributed_init, make_data_mesh
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.schedules import piecewise_linear
@@ -130,6 +131,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ratio", "-K", type=float, default=0.5)
     p.add_argument("--threshold", "-V", type=float, default=0.001)
     p.add_argument("--qstates", "-Q", type=int, default=255)
+    p.add_argument("--rank", type=int, default=4,
+                   help="r for powersgd (per-group payload r*(m + n/m) fp32 "
+                        "words on the psum ring)")
     p.add_argument("--block_size", type=int, default=256,
                    help="blocktopk: elements per contiguous block")
     p.add_argument("--bucket_mb", type=float, default=25.0,
@@ -333,6 +337,7 @@ def run(args) -> dict:
             block_size=args.block_size,
             bucket_mb=args.bucket_mb,
             wire_cap_ratio=args.wire_cap_ratio,
+            rank=args.rank,
             error_feedback=args.error_feedback,
         )
 
@@ -346,6 +351,7 @@ def run(args) -> dict:
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp, ndev),
         jax.random.key(args.seed + 1),
+        comp=init_comp_state(params, comp, ndev),
     )
     apply_fn = make_normalizing_apply_fn(
         module,

@@ -46,7 +46,8 @@ from tpu_compressed_dp.data import imagenet as data
 from tpu_compressed_dp.harness.loop import comm_summary, pad_batch, run_eval, run_train_epoch
 from tpu_compressed_dp.models import resnet as resnet_mod
 from tpu_compressed_dp.models.common import init_model, make_apply_fn
-from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
+from tpu_compressed_dp.parallel.dp import (CompressionConfig, init_comp_state,
+                                           init_ef_state)
 from tpu_compressed_dp.parallel.mesh import (
     distributed_init,
     make_data_mesh,
@@ -225,6 +226,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ratio", "-K", type=float, default=0.5)
     p.add_argument("--threshold", "-V", type=float, default=0.001)
     p.add_argument("--qstates", "-Q", type=int, default=255)
+    p.add_argument("--rank", type=int, default=4,
+                   help="r for powersgd (psum-ring low-rank factors)")
     p.add_argument("--block_size", type=int, default=256,
                    help="blocktopk: elements per contiguous block")
     p.add_argument("--bucket_mb", type=float, default=25.0,
@@ -330,11 +333,13 @@ def run(args) -> Dict[str, float]:
         qstates=args.qstates, block_size=args.block_size,
         bucket_mb=args.bucket_mb,
         wire_cap_ratio=args.wire_cap_ratio,
+        rank=args.rank,
         error_feedback=args.error_feedback,
     )
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp, ndev),
         jax.random.key((args.seed + 1) % (2**31)),
+        comp=init_comp_state(params, comp, ndev),
     )
 
     ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None

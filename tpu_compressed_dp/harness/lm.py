@@ -89,6 +89,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ratio", "-K", type=float, default=0.01)
     p.add_argument("--threshold", "-V", type=float, default=0.001)
     p.add_argument("--qstates", "-Q", type=int, default=255)
+    p.add_argument("--rank", type=int, default=4,
+                   help="r for powersgd (psum-ring low-rank factors)")
     p.add_argument("--block_size", type=int, default=256,
                    help="blocktopk: elements per contiguous block")
     p.add_argument("--bucket_mb", type=float, default=25.0,
@@ -175,9 +177,12 @@ def run(args) -> Dict[str, float]:
         qstates=args.qstates, block_size=args.block_size,
         bucket_mb=args.bucket_mb,
         wire_cap_ratio=args.wire_cap_ratio,
+        rank=args.rank,
         error_feedback=args.error_feedback,
     )
     if pipelined:
+        # NB make_pp_train_step rejects method='powersgd' (stacked-layer
+        # params shard over pipe; no warm-start init exists for that layout)
         from tpu_compressed_dp.train.pp_step import (
             init_pp_ef_state, make_pp_train_step, stack_layer_params,
         )
@@ -202,9 +207,12 @@ def run(args) -> Dict[str, float]:
             state = place_pp_state(state, cfg, comp, mesh)
             print(f"resumed step {int(state.step)}")
     else:
+        from tpu_compressed_dp.train.lm_step import init_lm_comp_state
+
         state = TrainState.create(
             params, {}, opt.init(params), init_lm_ef_state(cfg, params, comp, mesh),
             jax.random.key(args.seed + 1),
+            comp=init_lm_comp_state(cfg, params, comp, mesh),
         )
         ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
         if args.resume:

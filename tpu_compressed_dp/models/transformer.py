@@ -33,6 +33,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from tpu_compressed_dp import compat
 from jax.sharding import PartitionSpec as P
 
 from tpu_compressed_dp.ops.ring_attention import ring_attention
@@ -364,7 +366,17 @@ def use_fused_head_xent(n_tokens: int = 0, vocab: int = 0) -> bool:
 
     ``n_tokens``/``vocab`` are the per-worker logits dimensions at the call
     site (0 = unknown: auto resolves to off, preserving the pre-r5
-    default for callers that cannot size the buffer)."""
+    default for callers that cannot size the buffer).
+
+    Requires VMA typing: the custom VJP places its cross-shard cotangent
+    psums by diffing primal/cotangent varying-axes (``match_vma``), which
+    old JAX cannot express — there the hand-placed psums would silently
+    vanish and tp>1 gradients would be per-shard partials.  The unfused
+    vocab-parallel path is correct everywhere, so old JAX always takes it
+    (this is a peak-memory feature, not a correctness one).
+    """
+    if not compat.HAS_VMA:
+        return False
     if _FUSED_XENT in ("0", "1"):
         return _FUSED_XENT == "1"
     return n_tokens * vocab * 2 > _FUSED_XENT_AUTO_BYTES
@@ -431,11 +443,11 @@ def _fhx_scan_stats(h2, w, targets1, off, v_local, c, nc):
     # the varying h/w/targets — targets can vary on axes h does not, e.g.
     # pipe in the deferred-head uneven fallback); pcast the replicated init
     # so scan's carry types match
-    vma = tuple(sorted(getattr(jax.typeof(h2), "vma", frozenset())
-                       | getattr(jax.typeof(w), "vma", frozenset())
-                       | getattr(jax.typeof(targets1), "vma", frozenset())))
+    vma = tuple(sorted(getattr(compat.typeof(h2), "vma", frozenset())
+                       | getattr(compat.typeof(w), "vma", frozenset())
+                       | getattr(compat.typeof(targets1), "vma", frozenset())))
     if vma:
-        init = tuple(jax.lax.pcast(v, vma, to="varying") for v in init)
+        init = tuple(compat.pcast(v, vma, to="varying") for v in init)
     (m, l, zt), _ = jax.lax.scan(
         body, init, (w3.transpose(1, 0, 2), jnp.arange(nc)))
     return m, l, zt
@@ -504,13 +516,13 @@ def _fhx_bwd(tensor_axis, chunk, res, g):
         return dh, dw_c
 
     dh0 = jnp.zeros((n, d), jnp.float32)
-    vma = tuple(sorted(getattr(jax.typeof(h2), "vma", frozenset())
-                       | getattr(jax.typeof(w_p), "vma", frozenset())
-                       | getattr(jax.typeof(lse), "vma", frozenset())
-                       | getattr(jax.typeof(targets1), "vma", frozenset())
-                       | getattr(jax.typeof(dnll), "vma", frozenset())))
+    vma = tuple(sorted(getattr(compat.typeof(h2), "vma", frozenset())
+                       | getattr(compat.typeof(w_p), "vma", frozenset())
+                       | getattr(compat.typeof(lse), "vma", frozenset())
+                       | getattr(compat.typeof(targets1), "vma", frozenset())
+                       | getattr(compat.typeof(dnll), "vma", frozenset())))
     if vma:
-        dh0 = jax.lax.pcast(dh0, vma, to="varying")
+        dh0 = compat.pcast(dh0, vma, to="varying")
     dh, dw_stack = jax.lax.scan(body, dh0, (w3, jnp.arange(nc)))
     dw = dw_stack.transpose(1, 0, 2).reshape(d, v_pad)[:, :v_local]
 
@@ -522,8 +534,8 @@ def _fhx_bwd(tensor_axis, chunk, res, g):
     # pvary where replicated values meet varying operands; a custom VJP must
     # place them by hand.
     def match_vma(ct, primal):
-        extra = tuple(sorted(getattr(jax.typeof(ct), "vma", frozenset())
-                             - getattr(jax.typeof(primal), "vma",
+        extra = tuple(sorted(getattr(compat.typeof(ct), "vma", frozenset())
+                             - getattr(compat.typeof(primal), "vma",
                                        frozenset())))
         return jax.lax.psum(ct, extra) if extra else ct
 

@@ -394,10 +394,17 @@ class _Bound:
         return self.name in ("topk", "randomk", "thresholdv",
                              "adaptive_threshold", "blocktopk")
 
+    @property
+    def is_stateful(self) -> bool:
+        """Stateful compressors carry a persistent warm-start pytree through
+        the sync (``TrainState.comp``); the sync engines special-case them —
+        ``fn`` here is the stateless single-shot form."""
+        return self.name == "powersgd"
+
 
 def payload_bits_per_elem(
     name: str, *, qstates: int = 255, shared_mask: bool = False,
-    block_size: int = 256
+    block_size: int = 256, rank: int = 4, n: Optional[int] = None
 ) -> float:
     """Analytic wire width of one transmitted element, in bits.
 
@@ -423,8 +430,20 @@ def payload_bits_per_elem(
         ``qstates <= 127`` (8), uint8 magnitude + 1 packed sign bit for
         ``qstates <= 255`` (9), int16 beyond (16); + one fp32 norm
         (amortised).  The QSGD paper's variable-length bound is tighter but
-        these are the fixed-width layouts the TPU collective moves.
+        these are the fixed-width layouts the TPU collective moves;
+      * PowerSGD: the two fp32 factors, ``32·r·(m + n/m) / n`` bits per
+        element — shape-dependent, so ``n`` (the group's element count) is
+        required; dense-fallback groups (factors >= dense) bill 32.  Unlike
+        every sparsifier payload, the factors psum-reduce on the ring.
     """
+    if name == "powersgd":
+        if n is None:
+            raise ValueError(
+                "powersgd wire width is shape-dependent; pass n (the flat "
+                "group's element count)")
+        from tpu_compressed_dp.ops import lowrank
+
+        return lowrank.powersgd_group_bits(n, rank) / n
     if name in ("none", "thresholdv", "adaptive_threshold", "topk"):
         return 32.0 if name == "none" else 64.0
     if name == "randomk":
@@ -454,12 +473,15 @@ _ALIASES = {
     "randomdithering": "qsgd",
     "random_dithering": "qsgd",
     "qsgd": "qsgd",
+    "powersgd": "powersgd",
+    "power_sgd": "powersgd",
+    "lowrank": "powersgd",
     "none": "none",
     "dense": "none",
 }
 
 REGISTRY = ("none", "topk", "blocktopk", "randomk", "thresholdv",
-            "adaptive_threshold", "terngrad", "qsgd")
+            "adaptive_threshold", "terngrad", "qsgd", "powersgd")
 
 
 def canonical_name(method: Optional[str]) -> str:
@@ -481,6 +503,7 @@ def get_compressor(
     qstates: int = 255,
     block_size: int = 256,
     terngrad_chunk: int = 1 << 21,
+    rank: int = 4,
 ) -> _Bound:
     """Resolve a method name (canonical or reference spelling) to a bound op.
 
@@ -515,4 +538,15 @@ def get_compressor(
         )
     if canon == "qsgd":
         return _Bound("qsgd", lambda g, key: random_dithering(g, key, qstates=qstates), needs_rng=True)
+    if canon == "powersgd":
+        # the stateless single-shot form (one power iteration from a
+        # key-derived Q0); the sync engines special-case the warm-started
+        # stateful path — see ops/lowrank.py and parallel/dp.py
+        from tpu_compressed_dp.ops import lowrank
+
+        return _Bound(
+            "powersgd",
+            lambda g, key: lowrank.powersgd_approx(g, key, rank=rank),
+            needs_rng=True,
+        )
     raise AssertionError(canon)

@@ -40,6 +40,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from tpu_compressed_dp import compat
+
 try:  # Pallas TPU lowering is unavailable on some CPU-only builds
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -180,7 +182,7 @@ def _count_edges_kernel(edges_ref, x_ref, counts_ref):
 def _vma(x: Array):
     """Varying-mesh-axes of ``x`` — must be propagated onto pallas_call
     out_shapes when the kernel runs on device-varying data inside shard_map."""
-    return getattr(jax.typeof(x), "vma", frozenset())
+    return getattr(compat.typeof(x), "vma", frozenset())
 
 
 def _topk_threshold_pallas(
@@ -204,7 +206,7 @@ def _topk_threshold_pallas(
             pl.BlockSpec((_HIST_ROWS, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, _LANES), jnp.float32, vma=_vma(mag)),
+        out_shape=compat.shape_dtype_struct((1, _LANES), jnp.float32, vma=_vma(mag)),
         interpret=interpret,
     )
 
@@ -240,7 +242,7 @@ def _topk_threshold_pallas(
         if not vma:
             return vals
         return tuple(
-            jax.lax.pcast(v, vma, to="varying") if not _vma(v) else v for v in vals
+            compat.pcast(v, vma, to="varying") if not _vma(v) else v for v in vals
         )
 
     # max|g| strictly below hi so the top element always lands in a bin
@@ -298,7 +300,7 @@ def _topk_threshold_pallas(
     hi0 = full_init[1]                                       # max*(1+eps)
     edges = jnp.stack(
         [jnp.float32(0.0) if not _vma(mag)
-         else jax.lax.pcast(jnp.float32(0.0), tuple(_vma(mag)), to="varying")]
+         else compat.pcast(jnp.float32(0.0), tuple(_vma(mag)), to="varying")]
         + [jnp.minimum(e, hi0) for e in interior] + [hi0]
     )
 
@@ -313,7 +315,7 @@ def _topk_threshold_pallas(
         ],
         out_specs=pl.BlockSpec((1, _LANES), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, _LANES), jnp.float32, vma=_vma(mag)),
+        out_shape=compat.shape_dtype_struct((1, _LANES), jnp.float32, vma=_vma(mag)),
         interpret=interpret,
     )
     counts = count_edges(edges.reshape(1, -1), x2d)[0][:_HIST_BINS]
@@ -466,10 +468,10 @@ def fused_sparsify(acc: Array, t: Array, *, want_ef: bool = True,
     big = pl.BlockSpec((rows, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
     out_specs = [big] + ([big] if want_ef else []) + [
         pl.BlockSpec((1, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM)]
-    out_shape = [jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma)]
+    out_shape = [compat.shape_dtype_struct(x2d.shape, jnp.float32, vma=vma)]
     if want_ef:
-        out_shape.append(jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma))
-    out_shape.append(jax.ShapeDtypeStruct((1, _LANES), jnp.int32, vma=vma))
+        out_shape.append(compat.shape_dtype_struct(x2d.shape, jnp.float32, vma=vma))
+    out_shape.append(compat.shape_dtype_struct((1, _LANES), jnp.int32, vma=vma))
     outs = pl.pallas_call(
         functools.partial(_fused_sparsify_kernel, want_ef, n),
         grid=(num_chunks,),
@@ -697,18 +699,18 @@ def pack_by_threshold(acc: Array, t: Array, keep: int, *, want_ef: bool = True,
     cap_rows = pack_payload_slots(n, keep) // _LANES
     out_rows = cap_rows + _PACK_ROWS          # slack for the last DMA window
     out_shape = [
-        jax.ShapeDtypeStruct((out_rows, _LANES), jnp.float32, vma=vma),
-        jax.ShapeDtypeStruct((out_rows, _LANES), jnp.int32, vma=vma),
+        compat.shape_dtype_struct((out_rows, _LANES), jnp.float32, vma=vma),
+        compat.shape_dtype_struct((out_rows, _LANES), jnp.int32, vma=vma),
     ]
     out_specs = [
         pl.BlockSpec(memory_space=pltpu.ANY),
         pl.BlockSpec(memory_space=pltpu.ANY),
     ]
     if want_ef:
-        out_shape.append(jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma))
+        out_shape.append(compat.shape_dtype_struct(x2d.shape, jnp.float32, vma=vma))
         out_specs.append(pl.BlockSpec((_PACK_ROWS, _LANES), lambda i: (i, 0),
                                       memory_space=pltpu.VMEM))
-    out_shape.append(jax.ShapeDtypeStruct((1, 3), jnp.int32, vma=vma))
+    out_shape.append(compat.shape_dtype_struct((1, 3), jnp.int32, vma=vma))
     out_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     outs = pl.pallas_call(
         functools.partial(_pack_kernel, n, cap_rows, want_ef),
@@ -729,8 +731,8 @@ def pack_by_threshold(acc: Array, t: Array, keep: int, *, want_ef: bool = True,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
-        interpret=pltpu.InterpretParams() if interpret else False,
-        compiler_params=pltpu.CompilerParams(
+        interpret=compat.pallas_interpret_params() if interpret else False,
+        compiler_params=compat.pallas_compiler_params(
             has_side_effects=True,
             # the unrolled one-hot sub-blocks keep several [S,128,128]
             # temporaries live; the default 16M scoped-vmem limit is too
@@ -910,9 +912,9 @@ def seg_pack_by_threshold(acc: Array, t: Array, keep: int, *,
                            memory_space=pltpu.VMEM)
     out_specs = [seg_out, seg_out] + ([blk] if want_ef else [])
     out_shape = [
-        jax.ShapeDtypeStruct((nseg, _LANES), jnp.float32, vma=vma),
-        jax.ShapeDtypeStruct((nseg, _LANES), jnp.int32, vma=vma),
-    ] + ([jax.ShapeDtypeStruct(x2d.shape, jnp.float32, vma=vma)]
+        compat.shape_dtype_struct((nseg, _LANES), jnp.float32, vma=vma),
+        compat.shape_dtype_struct((nseg, _LANES), jnp.int32, vma=vma),
+    ] + ([compat.shape_dtype_struct(x2d.shape, jnp.float32, vma=vma)]
          if want_ef else [])
     outs = pl.pallas_call(
         functools.partial(_seg_pack_kernel, n, int(keep), want_ef),
@@ -1021,11 +1023,11 @@ def _run_quant(kernel, out_dtype, flat: Array, inv_scale: Array, seed: Array,
             pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(x2d.shape, out_dtype, vma=_vma(flat)),
+        out_shape=compat.shape_dtype_struct(x2d.shape, out_dtype, vma=_vma(flat)),
         # TPU-semantics interpreter: the stock HLO interpreter has no
         # prng_seed/prng_random_bits (NB: its PRNG is a zero stub — dither
         # u == 0 under interpretation; see tests/test_kernels.py)
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=compat.pallas_interpret_params() if interpret else False,
     )(
         seed.reshape(1, 1).astype(jnp.int32),
         inv_scale.reshape(1, 1).astype(jnp.float32),
@@ -1106,9 +1108,9 @@ def _uniform_pallas(seed: Array, n: int, interpret: bool = False) -> Array:
         in_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)],
         out_specs=pl.BlockSpec((_UNIFORM_ROWS, _LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((padded_n // _LANES, _LANES), jnp.float32,
+        out_shape=compat.shape_dtype_struct((padded_n // _LANES, _LANES), jnp.float32,
                                        vma=_vma(seed)),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=compat.pallas_interpret_params() if interpret else False,
     )(seed.reshape(1, 1).astype(jnp.int32))
     return out.reshape(-1)[:n]
 

@@ -1,0 +1,172 @@
+"""Rank-r PowerSGD low-rank gradient compression (Vogels et al., PAPERS.md).
+
+The six reference operators (:mod:`tpu_compressed_dp.ops.compressors`) are all
+element-wise sparsifiers/quantizers whose wire payloads carry worker-dependent
+supports (indices, scales) — every one of them except dense and shared-seed
+Random-K pays the all_gather penalty :func:`parallel.dp.wire_rides_psum`
+documents.  PowerSGD is the compressor family whose payload is *linear in the
+gradient*: each worker's factor ``P = M Q`` (and ``Q' = Mᵀ P̂``) can be
+psum-averaged directly, so the compressed sync always rides the cheap ring
+collective, at ``r·(m + n/m)`` fp32 words per ``n``-element group.
+
+Per leaf group (layerwise / bucketed / entiremodel — the same static grouping
+as the other engines):
+
+  1. reshape the flat accumulated gradient (grad + EF residual) to the
+     near-square ``[m, n2]`` matrix ``M`` (zero-padded; ``m ~ sqrt(n)``
+     minimises the factor payload ``m + n2``),
+  2. one power-iteration step against the persistent warm-start ``Q``:
+     ``P = M Q`` — psum-mean — Gram–Schmidt → ``P̂``,
+  3. ``Q' = Mᵀ P̂`` — psum-mean,
+  4. reconstruct ``Ĝ = P̂ Q'ᵀ`` (identical on every worker: both factors are
+     already averaged) and fold ``M − Ĝ`` into the error-feedback residual —
+     Sparsified SGD with Memory (Stich et al., PAPERS.md) applied to the
+     low-rank case.
+
+The warm start is what makes one iteration per step enough: ``Q`` persists in
+``TrainState.comp`` across steps (and through Orbax checkpoints), so the power
+iteration keeps refining the same dominant subspace the gradient stream
+actually occupies.  Because every nonlinear step (orthogonalisation) happens
+*after* a psum, the whole sync is linear in the per-worker inputs: the result
+equals running the same compression on the worker-mean gradient — the
+psum-linearity property ``tests/test_lowrank.py`` pins down.
+
+Groups too small for the factors to pay for themselves (``r·(m+n2) >= n``:
+biases, norm scales) psum dense instead — exact, and strictly cheaper.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["powersgd_dims", "gram_schmidt", "powersgd_approx",
+           "init_group_state", "powersgd_group_sync", "powersgd_group_bits"]
+
+
+def powersgd_dims(n: int, rank: int) -> Optional[Tuple[int, int, int]]:
+    """``(m, n2, r_eff)`` for compressing a flat ``n``-vector, or ``None``
+    when the factors would cost at least the dense vector (send dense).
+
+    ``m = round(sqrt(n))`` and ``n2 = ceil(n/m)`` minimise the per-rank
+    payload ``m + n2``; the effective rank is clamped to ``min(rank, m, n2)``
+    (a taller rank cannot add information).
+    """
+    if n <= 0:
+        return None
+    m = max(1, int(round(math.sqrt(n))))
+    n2 = -(-n // m)
+    r = max(1, min(rank, m, n2))
+    if r * (m + n2) >= n:
+        return None
+    return m, n2, r
+
+
+def powersgd_group_bits(n: int, rank: int) -> float:
+    """Analytic wire bits for one ``n``-element group: both fp32 factors
+    (``P`` then ``Q``) ride the psum ring; dense-fallback groups bill 32/elem."""
+    dims = powersgd_dims(n, rank)
+    if dims is None:
+        return 32.0 * n
+    m, n2, r = dims
+    return 32.0 * r * (m + n2)
+
+
+def gram_schmidt(p: Array, eps: float = 1e-8) -> Array:
+    """Orthonormalise the columns of ``p`` ([..., m, r]) by modified
+    Gram–Schmidt, batched over leading dims.
+
+    ``r`` is static and small (1–4), so the column loop unrolls at trace
+    time into ``r²/2`` fused dot/axpy passes — no iterative QR machinery.
+    Near-zero columns (zero gradient, or rank deficiency after projection)
+    normalise against ``eps`` and come back ~0 instead of NaN; the
+    reconstruction then simply spans fewer directions that step.
+    """
+    cols = []
+    for i in range(p.shape[-1]):
+        v = p[..., i]
+        for u in cols:
+            v = v - jnp.sum(u * v, axis=-1, keepdims=True) * u
+        norm = jnp.sqrt(jnp.sum(v * v, axis=-1, keepdims=True))
+        cols.append(v / jnp.maximum(norm, eps))
+    return jnp.stack(cols, axis=-1)
+
+
+def _as_matrix(flat: Array, m: int, n2: int) -> Array:
+    pad = m * n2 - flat.shape[0]
+    return jnp.pad(flat.astype(jnp.float32), (0, pad)).reshape(m, n2)
+
+
+def _dot(a: Array, b: Array) -> Array:
+    # HIGHEST: default matmul precision lowers fp32 operands to bf16 on TPU;
+    # the factor products ARE the payload, so precision loss here is wire
+    # noise that EF then has to re-absorb (same rationale as blocktopk_scores)
+    return jax.lax.dot(a, b, precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+
+
+def powersgd_approx(flat: Array, key: Array, *, rank: int) -> Array:
+    """Stateless single-shot rank-``r`` approximation of a flat vector (one
+    power iteration from a key-derived random ``Q0``).
+
+    This is the :func:`compressors.get_compressor`-registered form — same
+    math as one warm-started engine step, minus the persistent state and the
+    collectives — used for registry uniformity and local experimentation;
+    training syncs go through :func:`powersgd_group_sync`.
+    """
+    n = flat.shape[0]
+    dims = powersgd_dims(n, rank)
+    if dims is None:
+        return flat
+    m, n2, r = dims
+    mat = _as_matrix(flat, m, n2)
+    q0 = jax.random.normal(key, (n2, r), jnp.float32)
+    p_hat = gram_schmidt(_dot(mat, q0))
+    q = _dot(mat.T, p_hat)
+    return _dot(p_hat, q.T).reshape(-1)[:n].astype(flat.dtype)
+
+
+def init_group_state(n: int, rank: int, key: Array) -> Optional[Array]:
+    """Warm-start ``Q0 ~ N(0, 1)`` ([n2, r] fp32) for an ``n``-element group,
+    or ``None`` for dense-fallback groups.  Deterministic in ``key`` — every
+    worker must draw the IDENTICAL warm start or the very first P-psum would
+    average factors living in different bases."""
+    dims = powersgd_dims(n, rank)
+    if dims is None:
+        return None
+    _, n2, r = dims
+    return jax.random.normal(key, (n2, r), jnp.float32)
+
+
+def powersgd_group_sync(acc: Array, q: Array, rank: int, axis_name,
+                        world) -> Tuple[Array, Array, float, float]:
+    """One warm-started PowerSGD sync of a group's accumulated gradient.
+
+    ``acc``: the flat fp32 local gradient (+ EF residual); ``q``: this
+    group's persistent ``[n2, r]`` warm start.  Must run inside
+    ``shard_map`` over ``axis_name``.  Returns ``(recon, q_new, sent_elems,
+    sent_bits)`` — ``recon`` is the rank-r approximation of the WORKER-MEAN
+    gradient (both factors are psum-averaged before reconstruction), and
+    the caller folds ``acc - recon`` into the EF residual.
+    """
+    n = acc.shape[0]
+    dims = powersgd_dims(n, rank)
+    assert dims is not None, "dense-fallback groups never reach group_sync"
+    m, n2, r = dims
+    if q.shape != (n2, r):
+        raise ValueError(
+            f"warm-start Q shape {q.shape} does not match group dims "
+            f"({n2}, {r}) — was the compressor state built by init_comp_state "
+            "for this config and gradient tree?")
+    mat = _as_matrix(acc, m, n2)
+    p = jax.lax.psum(_dot(mat, q), axis_name) / world          # [m, r]
+    p_hat = gram_schmidt(p)
+    q_new = jax.lax.psum(_dot(mat.T, p_hat), axis_name) / world  # [n2, r]
+    recon = _dot(p_hat, q_new.T).reshape(-1)[:n]
+    sent = float(r * (m + n2))
+    return recon, q_new, sent, 32.0 * sent

@@ -548,7 +548,10 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
 
     Same contract as the simulate-mode sync in
     :func:`tpu_compressed_dp.parallel.dp.make_grad_sync` (which dispatches
-    here for ``mode='wire'``); must run inside ``shard_map`` over ``axis_name``.
+    here for ``mode='wire'`` and adapts this 3-tuple to its stateful
+    4-tuple — every wire method is stateless, so the compressor state
+    passes through untouched); must run inside ``shard_map`` over
+    ``axis_name``.
     """
     comp = compressors.get_compressor(
         cfg.method, ratio=cfg.ratio, threshold=cfg.threshold,

@@ -24,6 +24,17 @@ Two payload modes (SURVEY.md §2.3 item 6):
     convergence, not bandwidth; bytes-on-wire are *accounted analytically*.
   * ``mode='wire'`` — genuinely sparse payloads (packed k values; see
     :mod:`tpu_compressed_dp.ops.wire`), the `RandomKSparsifiedDDP` equivalent.
+
+Stateful compressors: every sync is ``sync(grads, ef, comp, key) ->
+(synced, new_ef, new_comp, stats)`` — ``comp`` is a persistent compressor
+state pytree threaded through the jitted step alongside the EF residual
+(``()`` for the stateless element-wise methods).  The first occupant is
+PowerSGD (``method='powersgd'``, :mod:`tpu_compressed_dp.ops.lowrank`),
+whose warm-start ``Q`` factors live in ``TrainState.comp``, are sharded
+like ``ef``, and round-trip through Orbax checkpoints; its payloads are
+linear in the gradient, so it is the one compressor family whose wire form
+always rides the psum ring rather than an all_gather.  Build the state
+with :func:`init_comp_state`.
 """
 
 from __future__ import annotations
@@ -38,7 +49,8 @@ from tpu_compressed_dp.ops import compressors, kernels
 
 __all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
            "make_leaf_groups", "group_concat", "group_split", "init_ef_state",
-           "make_sharded_clip", "wire_rides_psum"]
+           "init_comp_state", "init_comp_state_partitioned",
+           "init_comp_state_grouped", "make_sharded_clip", "wire_rides_psum"]
 
 
 def wire_rides_psum(name: str, n: int, cfg: "CompressionConfig") -> bool:
@@ -55,6 +67,11 @@ def wire_rides_psum(name: str, n: int, cfg: "CompressionConfig") -> bool:
     accounting.  Block-Top-K keep-all groups fall back to a dense psum.
     """
     if name == "none" or (name == "randomk" and cfg.resolved_shared_mask):
+        return True
+    if name == "powersgd":
+        # the factors P and Q are linear in the gradient — per-worker payloads
+        # sum meaningfully, so they always psum (ops/lowrank.py); dense
+        # fallback groups psum trivially
         return True
     if name == "blocktopk":
         kb = compressors.blocktopk_keep_blocks(n, cfg.ratio, cfg.block_size)
@@ -100,10 +117,18 @@ class CompressionConfig:
     """Mirrors the reference CLI surface (`dawn.py:15-19`, `train_imagenet_nv.py`).
 
     method:        none | topk | blocktopk | randomk | thresholdv |
-                   adaptive_threshold | terngrad | qsgd  (reference spellings
-                   accepted; blocktopk is net-new — contiguous-block Top-K by
-                   block L2 norm, the TPU-native fast wire path, see
-                   :mod:`tpu_compressed_dp.ops.wire`)
+                   adaptive_threshold | terngrad | qsgd | powersgd
+                   (reference spellings accepted; blocktopk is net-new —
+                   contiguous-block Top-K by block L2 norm, the TPU-native
+                   fast wire path, see :mod:`tpu_compressed_dp.ops.wire`;
+                   powersgd is net-new too — warm-started rank-``rank``
+                   low-rank factorisation whose P/Q payloads ride the psum
+                   ring, see :mod:`tpu_compressed_dp.ops.lowrank`.  PowerSGD
+                   is stateful: build ``TrainState.comp`` with
+                   :func:`init_comp_state`)
+    rank:          r for powersgd (default 4); per-group payload is
+                   ``r·(m + n/m)`` fp32 words for an ``n``-element group
+                   reshaped to ``(m, n/m)``, ``m ~ sqrt(n)``
     granularity:   'layerwise' (one op + one reduce per parameter tensor),
                    'entiremodel' (flatten the whole gradient, one op + reduce),
                    or 'bucketed' (contiguous parameter tensors concatenated
@@ -151,6 +176,9 @@ class CompressionConfig:
     ratio: float = 0.5
     threshold: float = 1e-3
     qstates: int = 255
+    # powersgd: rank of the low-rank approximation (r in Vogels et al.);
+    # wire cost per group is r*(m + n/m) fp32 words, always on the psum ring
+    rank: int = 4
     error_feedback: bool = False
     shared_mask: Optional[bool] = None
     check_sync: bool = False
@@ -173,6 +201,8 @@ class CompressionConfig:
     terngrad_chunk: int = -1
 
     def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
         if self.granularity not in ("layerwise", "entiremodel", "bucketed"):
             raise ValueError(
                 f"granularity must be layerwise|entiremodel|bucketed, got {self.granularity!r}")
@@ -218,6 +248,76 @@ def init_ef_state(grads_like: Any, cfg: CompressionConfig, num_devices: Optional
     return jax.tree.map(
         lambda g: jnp.zeros((num_devices,) + g.shape, dtype=jnp.float32), grads_like
     )
+
+
+def init_comp_state(grads_like: Any, cfg: CompressionConfig,
+                    num_devices: Optional[int] = None, *, seed: int = 0) -> Any:
+    """Persistent compressor-state pytree (``()`` for stateless methods).
+
+    PowerSGD: one fp32 warm-start ``Q`` of shape ``[n2, r]`` per compressed
+    leaf group (the same static grouping the sync uses), keyed ``'q<gi>'``.
+    Drawn from a fixed PRNG so every worker holds the IDENTICAL warm start —
+    the P/Q psums average factors, which is only meaningful when all workers
+    iterate in the same basis.  Dense-fallback groups (factors would cost >=
+    the dense vector: biases, norm scales) carry no state.
+
+    Like :func:`init_ef_state`, pass ``num_devices`` to get leaves with a
+    leading device axis, sharded over the data mesh axis and checkpointed as
+    ``TrainState.comp``.
+    """
+    if compressors.canonical_name(cfg.method) != "powersgd":
+        return ()
+    from tpu_compressed_dp.ops import lowrank
+
+    leaves = jax.tree.leaves(grads_like)
+    groups = make_leaf_groups(
+        [g.size * g.dtype.itemsize for g in leaves],
+        cfg.granularity, cfg.bucket_mb * BUCKET_MB)
+    key = jax.random.key(seed)
+    state = {}
+    for gi, idxs in enumerate(groups):
+        n = sum(leaves[i].size for i in idxs)
+        q = lowrank.init_group_state(n, cfg.rank, jax.random.fold_in(key, gi))
+        if q is None:
+            continue
+        if num_devices is not None:
+            q = jnp.tile(q[None], (num_devices, 1, 1))
+        state[f"q{gi}"] = q
+    return state if state else ()
+
+
+def init_comp_state_partitioned(grads_like: Any, cfg: CompressionConfig,
+                                leaf_axes, num_devices: Optional[int] = None,
+                                *, seed: int = 0) -> Any:
+    """Compressor state for :func:`make_partitioned_grad_sync`: one
+    :func:`init_comp_state` sub-pytree per replication signature, keyed
+    ``'sig<i>'`` in the same sorted-signature order the partitioned sync
+    iterates (``()`` when every signature is stateless)."""
+    if compressors.canonical_name(cfg.method) != "powersgd":
+        return ()
+    leaf_axes = [tuple(a) for a in leaf_axes]
+    sigs = sorted(set(leaf_axes))
+    leaves = jax.tree.leaves(grads_like)
+    state = {}
+    for gi, sig in enumerate(sigs):
+        sub = init_comp_state(
+            [l for l, a in zip(leaves, leaf_axes) if a == sig], cfg,
+            num_devices, seed=seed + gi)
+        if sub != ():
+            state[f"sig{gi}"] = sub
+    return state if state else ()
+
+
+def init_comp_state_grouped(grads_like: Any, cfg: CompressionConfig,
+                            is_sharded, shard_axis,
+                            num_devices: Optional[int] = None, *,
+                            seed: int = 0) -> Any:
+    """Binary convenience wrapper over :func:`init_comp_state_partitioned`
+    (mirrors :func:`make_grouped_grad_sync`)."""
+    axes = (shard_axis,) if isinstance(shard_axis, str) else tuple(shard_axis)
+    return init_comp_state_partitioned(
+        grads_like, cfg, [axes if s else () for s in is_sharded],
+        num_devices, seed=seed)
 
 
 # The reference's bucket unit is MiB: ``bucket_bytes_cap = bucket_cap_mb *
@@ -277,12 +377,17 @@ def group_split(flat, leaves, idxs, out, dtype=None):
 
 
 def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
-    """Build ``sync(grads, ef, key) -> (synced_grads, new_ef, comm_stats)``.
+    """Build ``sync(grads, ef, comp, key) -> (synced, new_ef, new_comp, stats)``.
 
     Must be called *inside* ``shard_map`` (uses ``lax.psum`` / ``axis_index``
     over ``axis_name``).  ``grads`` are the local worker's gradients at the
     same scale the reference compresses (see train/step.py); the return value
     is the world-averaged gradient, matching `core.py:217-222`.
+
+    ``comp`` is the persistent compressor-state pytree
+    (:func:`init_comp_state`): the PowerSGD warm-start factors, threaded in
+    and out of the jitted step like the EF residual.  Stateless methods take
+    and return ``()`` unchanged.
 
     ``comm_stats`` reports per-step communication analytically (SURVEY.md §5:
     the reference measured NIC bytes via /proc/net/dev; on TPU the payload is
@@ -295,14 +400,24 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     comp = compressors.get_compressor(
         cfg.method, ratio=cfg.ratio, threshold=cfg.threshold,
         qstates=cfg.qstates, block_size=cfg.block_size,
-        terngrad_chunk=cfg.resolved_terngrad_chunk,
+        terngrad_chunk=cfg.resolved_terngrad_chunk, rank=cfg.rank,
     )
+    if comp.name == "powersgd":
+        # stateful warm-started path; the factors ARE the wire form, so
+        # simulate and wire modes share it
+        return _make_powersgd_sync(cfg, axis_name)
     if cfg.mode == "wire" and comp.name != "none":
         # Dense (method=None) has no sparse representation — the simulate
         # path's full-size psum IS its wire format, so fall through.
         from tpu_compressed_dp.ops import wire
 
-        return wire.make_wire_grad_sync(cfg, axis_name)
+        wire_sync = wire.make_wire_grad_sync(cfg, axis_name)
+
+        def sync_wire(grads: Any, ef: Any, comp_state: Any, key: jax.Array):
+            out, new_ef, stats = wire_sync(grads, ef, key)
+            return out, new_ef, comp_state, stats
+
+        return sync_wire
     per_worker_rng = not cfg.resolved_shared_mask
     bits_per_elem = compressors.payload_bits_per_elem(
         comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask,
@@ -352,7 +467,8 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     def rides_psum(n_g: int) -> bool:
         return wire_rides_psum(comp.name, n_g, cfg)
 
-    def sync(grads: Any, ef: Any, key: jax.Array) -> Tuple[Any, Any, Dict[str, jax.Array]]:
+    def sync(grads: Any, ef: Any, comp_state: Any, key: jax.Array
+             ) -> Tuple[Any, Any, Any, Dict[str, jax.Array]]:
         world = jax.lax.psum(1, axis_name)
         leaves, treedef = jax.tree.flatten(grads)
         use_ef = cfg.error_feedback
@@ -418,7 +534,118 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
             "num_collectives": jnp.asarray(float(len(groups)), jnp.float32),
         }
-        return out, new_ef, stats
+        return out, new_ef, comp_state, stats
+
+    return sync
+
+
+def _make_powersgd_sync(cfg: CompressionConfig, axis_name):
+    """The stateful PowerSGD engine behind :func:`make_grad_sync`.
+
+    Per group: one warm-started power-iteration step against the persistent
+    ``Q`` (``comp['q<gi>']``), two psums (``P`` then ``Q``), reconstruct the
+    worker-mean low-rank gradient, fold the local deviation into the EF
+    residual.  Groups whose factors would cost >= dense psum the full vector
+    instead (exact; no state).  Every payload rides the psum ring —
+    ``sent_bits_allgather`` is structurally zero for this method.
+
+    ``check_sync`` (the ``check_reduction`` analog): the factor psums are
+    only meaningful when every worker iterates in the SAME basis, so the
+    guard verifies the warm-start ``Q`` agrees bitwise across workers before
+    compressing and reports ``comm/sync_agree`` (1.0 = agreement) — a
+    diverged warm start (e.g. mis-sharded restore) would otherwise corrupt
+    gradients as silently as misaligned Random-K indices.
+    """
+    from tpu_compressed_dp.ops import lowrank
+
+    if not cfg.error_feedback:
+        # the rank-r projection is biased and the residual carries real
+        # gradient mass every step (unlike the unbiased quantizers);
+        # training with it discarded silently underperforms — Vogels et al.
+        # always run PowerSGD with EF.  Legitimate EF-off uses exist
+        # (linearity analysis, payload benchmarking), hence a warning, not
+        # an error.
+        import warnings
+
+        warnings.warn(
+            "method='powersgd' without error_feedback=True discards the "
+            "low-rank residual every step; training quality degrades "
+            "silently — enable EF (Vogels et al. always do)",
+            stacklevel=2)
+
+    def sync(grads: Any, ef: Any, comp_state: Any, key: jax.Array
+             ) -> Tuple[Any, Any, Any, Dict[str, jax.Array]]:
+        world = jax.lax.psum(1, axis_name)
+        leaves, treedef = jax.tree.flatten(grads)
+        use_ef = cfg.error_feedback
+        ef_leaves = jax.tree.leaves(ef) if use_ef else [None] * len(leaves)
+        groups = make_leaf_groups(
+            [g.size * g.dtype.itemsize for g in leaves],
+            cfg.granularity, cfg.bucket_mb * BUCKET_MB)
+        out_leaves = [None] * len(leaves)
+        new_ef_leaves = [None] * len(leaves)
+        new_comp = {}
+        sent_total = 0.0
+        bits_total = 0.0
+        n_coll = 0
+        dense_total = 0.0
+        agrees = []
+        for gi, idxs in enumerate(groups):
+            flat = group_concat(leaves, idxs)
+            acc = flat + group_concat(ef_leaves, idxs) if use_ef else flat
+            acc = acc.astype(jnp.float32)
+            n_g = flat.shape[0]
+            if lowrank.powersgd_dims(n_g, cfg.rank) is None:
+                # factors would cost >= the dense vector: psum dense (exact)
+                recon = jax.lax.psum(acc, axis_name) / world
+                new_ef_flat = jnp.zeros_like(acc) if use_ef else None
+                group_sent, group_bits = float(n_g), 32.0 * n_g
+                n_coll += 1
+            else:
+                qk = f"q{gi}"
+                if not isinstance(comp_state, dict) or qk not in comp_state:
+                    raise ValueError(
+                        f"powersgd sync needs warm-start state {qk!r}; build "
+                        "TrainState.comp with init_comp_state(grads_like, "
+                        "cfg[, num_devices]) for this gradient tree")
+                q_in = comp_state[qk]
+                if cfg.check_sync:
+                    # pmax/pmin, not psum/world: summing W identical fp32
+                    # values is only exact when the reduction stays on
+                    # power-of-two partials (odd-count partial sums round),
+                    # so a mean-based bitwise compare false-alarms; max==min
+                    # is order-free and exact
+                    spread = (jax.lax.pmax(q_in, axis_name)
+                              - jax.lax.pmin(q_in, axis_name))
+                    agrees.append(
+                        (jnp.max(jnp.abs(spread)) == 0.0).astype(jnp.float32))
+                recon, q_new, group_sent, group_bits = (
+                    lowrank.powersgd_group_sync(
+                        acc, q_in, cfg.rank, axis_name, world))
+                new_comp[qk] = q_new
+                new_ef_flat = acc - recon if use_ef else None
+                n_coll += 2  # P-psum + Q-psum
+            group_split(recon, leaves, idxs, out_leaves)
+            if use_ef:
+                group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
+                            dtype=jnp.float32)
+            sent_total += group_sent
+            bits_total += group_bits
+            dense_total += float(n_g)
+
+        out = jax.tree.unflatten(treedef, out_leaves)
+        new_ef = jax.tree.unflatten(treedef, new_ef_leaves) if use_ef else ()
+        stats = {
+            "sent_elems": jnp.asarray(sent_total, jnp.float32),
+            "sent_bits": jnp.asarray(bits_total, jnp.float32),
+            "sent_bits_psum": jnp.asarray(bits_total, jnp.float32),
+            "sent_bits_allgather": jnp.asarray(0.0, jnp.float32),
+            "dense_elems": jnp.asarray(dense_total, jnp.float32),
+            "num_collectives": jnp.asarray(float(n_coll), jnp.float32),
+        }
+        if agrees:
+            stats["sync_agree"] = jnp.min(jnp.stack(agrees))
+        return out, new_ef, new_comp if new_comp else (), stats
 
     return sync
 
@@ -440,6 +667,10 @@ def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
     masks) or none (independent shards).  Comm stats report model-wide
     totals: each group's per-rank stats psum over exactly its signature's
     axes.
+
+    Compressor state is per signature: a ``{'sig<i>': sub}`` dict in the
+    sorted-signature order (:func:`init_comp_state_partitioned`), ``()``
+    when stateless.
     """
     base_sync = make_grad_sync(cfg, axis_name=sync_axes)
     leaf_axes = [tuple(a) for a in leaf_axes]
@@ -457,15 +688,21 @@ def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
         leaves = [next(its[g]) for g in group_of]
         return jax.tree.unflatten(jax.tree.structure(like), leaves)
 
-    def sync(grads, ef, key):
+    def sync(grads, ef, comp, key):
         use_ef = cfg.error_feedback
         g_groups = split(grads)
         e_groups = split(ef) if use_ef else [() for _ in sigs]
         keys = jax.random.split(key, len(sigs))
         out_g, out_e, comm = [], [], None
+        new_comp = {}
         for gi, sig in enumerate(sigs):
-            s_g, s_e, s_comm = base_sync(
-                g_groups[gi], e_groups[gi] if use_ef else (), keys[gi])
+            sub_comp = (comp.get(f"sig{gi}", ())
+                        if isinstance(comp, dict) else ())
+            s_g, s_e, s_comp, s_comm = base_sync(
+                g_groups[gi], e_groups[gi] if use_ef else (), sub_comp,
+                keys[gi])
+            if s_comp != ():
+                new_comp[f"sig{gi}"] = s_comp
             out_g.append(s_g)
             out_e.append(s_e)
             if sig:
@@ -482,13 +719,21 @@ def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
                     k: comm.get(k, 0.0) + s_comm.get(k, 0.0)
                     for k in (set(comm) | set(s_comm)) - {"sync_agree"}
                 }
-                if "sync_agree" in comm and "sync_agree" in s_comm:
-                    merged["sync_agree"] = jnp.minimum(
-                        comm["sync_agree"], s_comm["sync_agree"])
+                # keep the diagnostic when EITHER side reports it: a
+                # signature of dense-fallback-only groups emits no
+                # sync_agree, and dropping the other side's value would
+                # silence exactly the divergence signal check_sync exists
+                # to surface
+                agree_vals = [c["sync_agree"] for c in (comm, s_comm)
+                              if "sync_agree" in c]
+                if agree_vals:
+                    merged["sync_agree"] = (
+                        agree_vals[0] if len(agree_vals) == 1
+                        else jnp.minimum(*agree_vals))
                 comm = merged
         synced = merge(grads, out_g)
         new_ef = merge(ef, out_e) if use_ef else ()
-        return synced, new_ef, comm
+        return synced, new_ef, new_comp if new_comp else (), comm
 
     return sync
 

@@ -32,7 +32,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from tpu_compressed_dp import compat
+from tpu_compressed_dp.compat import shard_map
 
 from tpu_compressed_dp.models.transformer import (
     LlamaConfig,
@@ -53,7 +54,8 @@ from tpu_compressed_dp.train.step import optimizer_lr
 
 Array = jax.Array
 
-__all__ = ["make_lm_train_step", "init_lm_ef_state", "lm_state_specs", "make_lm_mesh"]
+__all__ = ["make_lm_train_step", "init_lm_ef_state", "init_lm_comp_state",
+           "lm_state_specs", "make_lm_mesh"]
 
 LM_AXES = ("data", "seq", "tensor")
 
@@ -84,6 +86,37 @@ def _ef_specs(pspecs: Any) -> Any:
     )
 
 
+def _lm_is_sharded(cfg: LlamaConfig):
+    pspec_leaves = jax.tree.leaves(
+        param_specs(cfg), is_leaf=lambda x: isinstance(x, P))
+    return [any(ax == "tensor" for ax in spec) for spec in pspec_leaves]
+
+
+def init_lm_comp_state(cfg: LlamaConfig, params: Any, comp: CompressionConfig,
+                       mesh: Mesh) -> Any:
+    """Compressor state (PowerSGD warm-start Q) for the LM step, with the
+    same signature grouping ``make_lm_train_step``'s grouped sync uses and a
+    leading (data*seq) worker axis like :func:`init_lm_ef_state`.
+
+    Tensor-sharded parameter groups sync on per-shard flats whose sizes this
+    (global-shape) init cannot see, so stateful compression currently
+    requires ``tensor == 1``; replicated-signature groups are what the DP
+    sync engine compresses anyway.
+    """
+    from tpu_compressed_dp.ops.compressors import canonical_name
+    from tpu_compressed_dp.parallel.dp import init_comp_state_grouped
+
+    if canonical_name(comp.method) != "powersgd":
+        return ()
+    if mesh.shape.get("tensor", 1) > 1:
+        raise NotImplementedError(
+            "powersgd over tensor-sharded params needs shard-local warm "
+            "starts; run it on a (data[, seq]) mesh (tensor=1)")
+    workers = mesh.shape["data"] * mesh.shape["seq"]
+    return init_comp_state_grouped(
+        params, comp, _lm_is_sharded(cfg), "tensor", workers)
+
+
 def lm_state_specs(cfg: LlamaConfig, comp: CompressionConfig) -> TrainState:
     """PartitionSpec pytree for the LM TrainState (shard_map in/out specs)."""
     pspecs = param_specs(cfg)
@@ -94,6 +127,10 @@ def lm_state_specs(cfg: LlamaConfig, comp: CompressionConfig) -> TrainState:
         opt_state={"momentum": pspecs},
         ef=_ef_specs(pspecs) if comp.error_feedback else P(),
         rng=P(),
+        # compressor state (powersgd warm-start Q): leading (data, seq)
+        # worker axis, inner dims unsharded — build with
+        # init_comp_state_grouped(..., num_devices=data*seq)
+        comp=P(("data", "seq")),
     )
 
 
@@ -127,16 +164,24 @@ def make_lm_train_step(
     leaves (already psum'd by shard_map AD) count once.
     """
     cfg.validate_mesh(mesh.shape["tensor"])
+    from tpu_compressed_dp.ops.compressors import canonical_name
+
+    if (canonical_name(comp_cfg.method) == "powersgd"
+            and mesh.shape["tensor"] > 1):
+        # same limitation init_lm_comp_state documents, guarded at the
+        # factory so direct API users get the real reason, not a generic
+        # missing-warm-start error for state no init can build
+        raise NotImplementedError(
+            "powersgd over tensor-sharded params needs shard-local warm "
+            "starts; run it on a (data[, seq]) mesh (tensor=1)")
     sync_axes = ("data", "seq")
     n_workers = mesh.shape["data"] * mesh.shape["seq"]
 
     # Tensor-sharded and tensor-replicated leaves sync as separate groups so
     # data-dependent compression masks cannot de-synchronise replicated
-    # params across tensor shards (see make_grouped_grad_sync).
-    pspec_leaves = jax.tree.leaves(
-        param_specs(cfg), is_leaf=lambda x: isinstance(x, P)
-    )
-    is_sharded = [any(ax == "tensor" for ax in spec) for spec in pspec_leaves]
+    # params across tensor shards (see make_grouped_grad_sync); the same
+    # grouping drives init_lm_comp_state so warm-start state lines up.
+    is_sharded = _lm_is_sharded(cfg)
     grad_sync = make_grouped_grad_sync(comp_cfg, sync_axes, is_sharded, "tensor")
 
     clip_tree = make_sharded_clip(is_sharded, "tensor")
@@ -164,15 +209,18 @@ def make_lm_train_step(
             return xent + cfg.moe_aux_weight * aux, xent
 
         varying = jax.tree.map(
-            lambda p: jax.lax.pcast(p, sync_axes, to="varying"), state.params
+            lambda p: compat.pcast(p, sync_axes, to="varying"), state.params
         )
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying)
         if clip_norm > 0.0:
             grads = clip_tree(grads, clip_norm)
 
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
-        synced, new_ef, comm = grad_sync(grads, ef_local, comp_key)
+        comp_local = jax.tree.map(lambda c: c[0], state.comp)
+        synced, new_ef, new_comp, comm = grad_sync(
+            grads, ef_local, comp_local, comp_key)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
+        new_comp = jax.tree.map(lambda c: c[None], new_comp)
         if clip_sent_norm > 0.0:
             synced = clip_tree(synced, clip_sent_norm)
 
@@ -190,7 +238,7 @@ def make_lm_train_step(
 
         return dataclasses.replace(
             state, step=new_step, params=new_params, opt_state=new_opt,
-            ef=new_ef,
+            ef=new_ef, comp=new_comp,
         ), metrics
 
     state_spec = lm_state_specs(cfg, comp_cfg)

@@ -42,7 +42,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from tpu_compressed_dp import compat
+from tpu_compressed_dp.compat import shard_map
 
 from tpu_compressed_dp.models.transformer import (
     LlamaConfig,
@@ -165,6 +166,8 @@ def pp_state_specs(cfg: LlamaConfig, comp: CompressionConfig,
         opt_state={"momentum": pspecs},
         ef=ef_specs if comp.error_feedback else P(),
         rng=P(),
+        # compressor state (powersgd warm-start Q): leading worker axis only
+        comp=P(worker_ax),
     )
 
 
@@ -219,6 +222,14 @@ def make_pp_train_step(
     the full model — pipe-sharded layer stacks psum their squared norms
     over ``pipe``, replicated embed/head/norm leaves count once.
     """
+    from tpu_compressed_dp.ops.compressors import canonical_name
+
+    if canonical_name(comp_cfg.method) == "powersgd":
+        # stacked-layer params shard over the pipe axis, so warm-start
+        # factors would need per-stage shapes no current init can build
+        raise NotImplementedError(
+            "powersgd is not yet supported with pipeline parallelism; "
+            "run it on a (data[, seq]) mesh")
     stages = mesh.shape["pipe"]
     tp = mesh.shape.get("tensor", 1)
     sp = mesh.shape.get("seq", 1)
@@ -290,13 +301,13 @@ def make_pp_train_step(
                 inject = (stage == 0) & (t < M)
                 x_t = xs[jnp.clip(t, 0, M - 1)]
                 emb = params["embed"].astype(dt)[x_t]
-                emb = jax.lax.pcast(emb, ("pipe",), to="varying")
+                emb = compat.pcast(emb, ("pipe",), to="varying")
                 h_in = jnp.where(inject, emb, h_cur)
                 h_out = stage_apply(h_in)
                 h_next = jax.lax.ppermute(h_out, "pipe", perm)
                 return h_next, h_out
 
-            h0 = jax.lax.pcast(jnp.zeros((mb, t_len, cfg.dim), dt),
+            h0 = compat.pcast(jnp.zeros((mb, t_len, cfg.dim), dt),
                                sync_axes + ("pipe",), to="varying")
             _, h_ticks = jax.lax.scan(tick, h0, jnp.arange(M + stages - 1))
             # The final-norm + LM-head + loss are DEFERRED past the loop
@@ -318,12 +329,12 @@ def make_pp_train_step(
                 m_s = M // stages
                 my_h = jax.lax.dynamic_slice_in_dim(emitted, stage * m_s, m_s)
                 my_y = jax.lax.dynamic_slice_in_dim(
-                    jax.lax.pcast(ys, ("pipe",), to="varying"),
+                    compat.pcast(ys, ("pipe",), to="varying"),
                     stage * m_s, m_s)
                 scale = 1.0 / stages
             else:  # uneven split: every stage heads the full drained set
                 m_s, my_h, scale = M, emitted, 1.0 / stages
-                my_y = jax.lax.pcast(ys, ("pipe",), to="varying")
+                my_y = compat.pcast(ys, ("pipe",), to="varying")
             hn = _rms_norm(my_h.reshape(m_s * mb, t_len, cfg.dim),
                            params["final_norm"], cfg.norm_eps)
             if use_fused_head_xent(m_s * mb * t_len, cfg.vocab_size // tp):
@@ -340,15 +351,18 @@ def make_pp_train_step(
             return loss
 
         varying = jax.tree.map(
-            lambda p: jax.lax.pcast(p, sync_axes, to="varying"), state.params
+            lambda p: compat.pcast(p, sync_axes, to="varying"), state.params
         )
         loss, grads = jax.value_and_grad(loss_fn)(varying)
         if clip_norm > 0.0:
             grads = clip_tree(grads, clip_norm)
 
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
-        synced, new_ef, comm = grad_sync(grads, ef_local, comp_key)
+        comp_local = jax.tree.map(lambda c: c[0], state.comp)
+        synced, new_ef, new_comp, comm = grad_sync(
+            grads, ef_local, comp_local, comp_key)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
+        new_comp = jax.tree.map(lambda c: c[None], new_comp)
         if clip_sent_norm > 0.0:
             synced = clip_tree(synced, clip_sent_norm)
 
@@ -365,7 +379,7 @@ def make_pp_train_step(
             metrics[f"comm/{k}"] = jax.lax.pmean(v, sync_axes)
         return dataclasses.replace(
             state, step=new_step, params=new_params, opt_state=new_opt,
-            ef=new_ef,
+            ef=new_ef, comp=new_comp,
         ), metrics
 
     state_spec = pp_state_specs(cfg, comp_cfg, tensor=tp > 1, seq=sp > 1)

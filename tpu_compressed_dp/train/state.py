@@ -3,7 +3,11 @@
 Unlike the reference — where momentum lives in torch.optim, BN stats inside
 modules, and the error-feedback residual in a wrapper that is *not*
 checkpointed (SURVEY.md §5) — everything mutable is explicit here and goes
-through Orbax as a unit: ``{step, params, batch_stats, opt_state, ef, rng}``.
+through Orbax as a unit: ``{step, params, batch_stats, opt_state, ef, rng,
+comp}``.  ``comp`` is the persistent compressor state (PowerSGD warm-start
+factors, :func:`tpu_compressed_dp.parallel.dp.init_comp_state`): it shards
+and checkpoints exactly like the EF residual, so a resumed run keeps the
+power iteration's converged subspace instead of re-warming from random.
 """
 
 from __future__ import annotations
@@ -27,9 +31,11 @@ class TrainState:
     opt_state: Any             # optimizer buffers (momentum, ...)
     ef: Any                    # error-feedback residual pytree, or () when off
     rng: jax.Array             # base PRNG key; per-step keys are folded from it
+    comp: Any = ()             # compressor state (PowerSGD warm-start Q), or ()
 
     @classmethod
-    def create(cls, params: Any, batch_stats: Any, opt_state: Any, ef: Any, rng: jax.Array):
+    def create(cls, params: Any, batch_stats: Any, opt_state: Any, ef: Any,
+               rng: jax.Array, comp: Any = ()):
         return cls(
             step=jnp.asarray(0, jnp.int32),
             params=params,
@@ -37,22 +43,25 @@ class TrainState:
             opt_state=opt_state,
             ef=ef,
             rng=rng,
+            comp=comp,
         )
 
     def with_mesh_sharding(self, mesh: Mesh, axis_name: str = "data") -> "TrainState":
         """Place the state on ``mesh``: everything replicated except the
-        per-worker EF residual, sharded on its leading device axis.  Needed
-        after a checkpoint restore (which lands arrays on one device) before
-        the shard_map'd step will accept the state."""
+        per-worker EF residual and compressor state, sharded on their
+        leading device axis.  Needed after a checkpoint restore (which lands
+        arrays on one device) before the shard_map'd step will accept the
+        state."""
         rep = NamedSharding(mesh, P())
         dat = NamedSharding(mesh, P(axis_name))
         placed = {
             f.name: jax.device_put(getattr(self, f.name), rep)
             for f in dataclasses.fields(self)
-            if f.name != "ef"
+            if f.name not in ("ef", "comp")
         }
         ef = self.ef if self.ef == () else jax.device_put(self.ef, dat)
-        return dataclasses.replace(self, ef=ef, **placed)
+        comp = self.comp if self.comp == () else jax.device_put(self.comp, dat)
+        return dataclasses.replace(self, ef=ef, comp=comp, **placed)
 
     def place_with_specs(self, specs: "TrainState", mesh: Mesh) -> "TrainState":
         """Place every field per a specs-TrainState (fields are PartitionSpecs
@@ -66,7 +75,7 @@ class TrainState:
         placed = {}
         for f in dataclasses.fields(self):
             val, spec = getattr(self, f.name), getattr(specs, f.name)
-            if f.name == "ef" and self.ef == ():
+            if f.name in ("ef", "comp") and val == ():
                 placed[f.name] = ()
             elif isinstance(spec, P):
                 placed[f.name] = jax.tree.map(lambda v: place(v, spec), val)

@@ -31,7 +31,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from tpu_compressed_dp import compat
+from tpu_compressed_dp.compat import shard_map
 
 from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grad_sync
 from tpu_compressed_dp.train.optim import SGD
@@ -122,12 +123,16 @@ def make_train_step(
             gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(scaled)))
             factor = jnp.minimum(1.0, clip_norm * grad_scale / jnp.maximum(gnorm, 1e-20))
             scaled = jax.tree.map(lambda g: g * factor, scaled)
-        # EF residual is per-worker state (the reference's per-rank epsilon,
-        # sparsified_ddp.py:222): stored with a leading device axis, sharded
-        # over the mesh; squeeze the local slice here.
+        # EF residual and compressor state are per-worker state (the
+        # reference's per-rank epsilon, sparsified_ddp.py:222; PowerSGD's
+        # warm-start Q): stored with a leading device axis, sharded over the
+        # mesh; squeeze the local slice here.
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
-        synced, new_ef, comm = grad_sync(scaled, ef_local, comp_key)
+        comp_local = jax.tree.map(lambda c: c[0], state.comp)
+        synced, new_ef, new_comp, comm = grad_sync(
+            scaled, ef_local, comp_local, comp_key)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
+        new_comp = jax.tree.map(lambda c: c[None], new_comp)
         if clip_sent_norm > 0.0:
             snorm = jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(synced)))
             sfactor = jnp.minimum(
@@ -161,11 +166,13 @@ def make_train_step(
             batch_stats=new_bs,
             opt_state=new_opt,
             ef=new_ef,
+            comp=new_comp,
         )
         return new_state, metrics
 
     state_spec = TrainState(
-        step=P(), params=P(), batch_stats=P(), opt_state=P(), ef=P(axis_name), rng=P()
+        step=P(), params=P(), batch_stats=P(), opt_state=P(), ef=P(axis_name),
+        rng=P(), comp=P(axis_name),
     )
     sharded = shard_map(
         local_step,
@@ -180,13 +187,19 @@ def make_train_step(
     n_dev = mesh.shape[axis_name]
 
     def train_step(state: TrainState, batch: Dict[str, Array]):
-        for leaf in jax.tree.leaves(state.ef):
-            if leaf.ndim < 1 or leaf.shape[0] != n_dev:
-                raise ValueError(
-                    f"EF residual leaves need a leading device axis of size {n_dev} "
-                    f"(got shape {leaf.shape}); build them with "
-                    f"init_ef_state(params, cfg, num_devices={n_dev})"
-                )
+        if comp_cfg.error_feedback and state.ef == ():
+            raise ValueError(
+                "error_feedback=True but state.ef is empty; build it with "
+                f"init_ef_state(params, cfg, num_devices={n_dev})")
+        for field, hint in (("ef", "init_ef_state(params, cfg"),
+                            ("comp", "init_comp_state(params, cfg")):
+            for leaf in jax.tree.leaves(getattr(state, field)):
+                if leaf.ndim < 1 or leaf.shape[0] != n_dev:
+                    raise ValueError(
+                        f"{field} leaves need a leading device axis of size "
+                        f"{n_dev} (got shape {leaf.shape}); build them with "
+                        f"{hint}, num_devices={n_dev})"
+                    )
         return jitted(state, batch["input"], batch["target"])
 
     return train_step
@@ -195,7 +208,7 @@ def make_train_step(
 def _to_varying(x: Array, axis_name: str) -> Array:
     """Mark a replicated value as device-varying (identity on the forward pass,
     blocks the automatic psum on the backward pass)."""
-    return jax.lax.pcast(x, axis_name, to="varying")
+    return compat.pcast(x, axis_name, to="varying")
 
 
 def optimizer_lr(optimizer: SGD, step: Array) -> Array:
@@ -230,7 +243,8 @@ def make_eval_step(apply_fn: ApplyFn, mesh: Mesh, *, axis_name: str = "data"):
         }
 
     state_spec = TrainState(
-        step=P(), params=P(), batch_stats=P(), opt_state=P(), ef=P(axis_name), rng=P()
+        step=P(), params=P(), batch_stats=P(), opt_state=P(), ef=P(axis_name),
+        rng=P(), comp=P(axis_name),
     )
     sharded = shard_map(
         local_eval,

@@ -85,13 +85,36 @@ class Checkpointer:
         step = step if step is not None else self.manager.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory!r}")
-        payload = self.manager.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(_to_saveable(target_state)),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+        template = _to_saveable(target_state)
+        try:
+            payload = self.manager.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(template),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
+        except ValueError as e:
+            # Checkpoints written before stateful compressors have no 'comp'
+            # entry, and Orbax rejects a template with keys the saved tree
+            # lacks — retry without it (_from_saveable then keeps the
+            # caller's comp).  No error-message sniffing: Orbax also rejects
+            # templates MISSING a saved key, so the comp-less retry can only
+            # succeed when the save genuinely predates 'comp'; for any other
+            # mismatch (shape/rank changes, renamed keys) the retry fails
+            # too and the ORIGINAL error propagates.
+            try:
+                payload = self.manager.restore(
+                    step,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(
+                            {k: v for k, v in template.items()
+                             if k != "comp"}),
+                        meta=ocp.args.JsonRestore(),
+                    ),
+                )
+            except ValueError:
+                raise e
         state = _from_saveable(target_state, payload["state"])
         meta = dict(payload.get("meta") or {})
         if "best_metric" in meta:
@@ -106,8 +129,9 @@ def _to_saveable(state: TrainState) -> Dict[str, Any]:
     d = {f.name: getattr(state, f.name) for f in dataclasses.fields(state)}
     # PRNG keys: store raw key data (typed keys are not serialisable)
     d["rng"] = jax.random.key_data(d["rng"])
-    # ef == () when off; Orbax cannot round-trip an empty container leaf
+    # ef/comp == () when off; Orbax cannot round-trip an empty container leaf
     d["ef"] = {"on": d["ef"]} if d["ef"] != () else {}
+    d["comp"] = {"on": d["comp"]} if d["comp"] != () else {}
     return d
 
 
@@ -116,6 +140,13 @@ def _from_saveable(target: TrainState, d: Dict[str, Any]) -> TrainState:
     d["rng"] = jax.random.wrap_key_data(np.asarray(d["rng"]))
     ef = d["ef"]
     d["ef"] = ef["on"] if "on" in ef else ()
+    if "comp" in d:
+        d["comp"] = d["comp"]["on"] if "on" in d["comp"] else ()
+    else:
+        # checkpoint written before stateful compressors: keep the caller's
+        # comp (a freshly-built warm start when resuming an old run with
+        # powersgd newly enabled; () otherwise) instead of clobbering it
+        d["comp"] = target.comp
     return dataclasses.replace(target, **d)
 
 
