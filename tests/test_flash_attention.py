@@ -49,3 +49,30 @@ def test_forward_and_grads_match_exact(shape):
     for a, b, nm in zip(gf, ge, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5,
                                    err_msg=f"d{nm}")
+
+
+def test_streamed_dkv_matches_resident(monkeypatch):
+    """The DMA/double-buffered dkv kernel (`_dkv_kernel_streamed`) against
+    the VMEM-resident form, both under interpret: the streamed path is the
+    only one real TPU runs take for the backward, but interpret mode (the
+    only CI-runnable path) defaulted to the resident kernel — so the
+    explicit-DMA machinery had zero off-chip coverage (ADVICE r5).
+    `TPU_CDP_FORCE_STREAMED_DKV=1` runs it under the Pallas interpreter;
+    the two must agree to fp32 roundoff (identical math via
+    `_dkv_block_math`, different operand staging)."""
+    shape = (1, 2, 256, 64)
+    ks = jax.random.split(jax.random.key(3), 4)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) * 0.5
+               for kk in ks[:3])
+    tgt = jax.random.normal(ks[3], shape)
+
+    def loss(q, k, v):
+        return jnp.mean((flash_causal_attention(q, k, v, None, True) - tgt) ** 2)
+
+    monkeypatch.delenv("TPU_CDP_FORCE_STREAMED_DKV", raising=False)
+    g_resident = jax.grad(loss, (0, 1, 2))(q, k, v)
+    monkeypatch.setenv("TPU_CDP_FORCE_STREAMED_DKV", "1")
+    g_streamed = jax.grad(loss, (0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g_streamed, g_resident, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6,
+                                   err_msg=f"d{nm} streamed vs resident")

@@ -366,3 +366,22 @@ class TestFusedHeadXent:
                                    atol=1e-6)
         np.testing.assert_allclose(np.asarray(dh_f), np.asarray(dh_r),
                                    atol=1e-6)
+
+
+def test_fused_xent_auto_uses_logits_itemsize(monkeypatch):
+    """ADVICE r5: the auto heuristic must size the logits buffer at the
+    CONFIG's dtype width, not hardcoded bf16 — an fp32 config crosses the
+    1 GiB auto-on threshold at half the token*vocab product."""
+    from tpu_compressed_dp import compat
+    from tpu_compressed_dp.models import transformer as tf_mod
+
+    # exercise the size heuristic itself even where the VMA gate would
+    # force the unfused path (old jax)
+    monkeypatch.setattr(compat, "HAS_VMA", True)
+    monkeypatch.setattr(tf_mod, "_FUSED_XENT", "")
+    elems = (1 << 28) + 1  # > 1 GiB at fp32, exactly half that at bf16
+    assert tf_mod.use_fused_head_xent(elems, 1, itemsize=4)
+    assert not tf_mod.use_fused_head_xent(elems, 1, itemsize=2)
+    # the default preserves the r5 bf16 behaviour
+    assert not tf_mod.use_fused_head_xent(elems, 1)
+    assert tf_mod.use_fused_head_xent((1 << 29) + 1, 1)

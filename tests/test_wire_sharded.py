@@ -1,0 +1,336 @@
+"""Owner-sharded sparse-allreduce transport (``transport='sharded'``,
+ops/wire_sharded.py) against the flat all_gather combine.
+
+The contract under test: with lossless capacities the sharded route ->
+owner-reduce -> return pipeline produces IDENTICAL synced gradients and EF
+residuals to the allgather combine (same selections, same scatter-add sums
+— fp32 summation-order tolerance only), while at the default capacity
+factors its per-chip billed wire traffic for Top-K k=1% at W=8 is at most
+1/3 of the allgather transport's, trending as O(k + n/W) vs O(W*k).
+Clipping (route buckets or the return union) folds into the EF residual —
+transmitted + residual must equal the accumulated gradient exactly — and
+is surfaced via ``comm/shard_overflow``.
+
+Unlike tests/test_wire.py (whole-module ``slow``), these stay in tier-1:
+each grid point compiles ONE shard_map computing both transports, and the
+matrix covers every axis (method x world size x granularity) without the
+full cross-product.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from tpu_compressed_dp.compat import shard_map
+
+from tpu_compressed_dp.ops import wire, wire_sharded
+from tpu_compressed_dp.parallel.dp import (CompressionConfig,
+                                           _sharded_group_bits,
+                                           make_grad_sync, wire_rides_psum,
+                                           wire_transport)
+from tpu_compressed_dp.utils.meters import per_chip_traffic_bytes
+
+pytestmark = pytest.mark.quick
+
+LOSSLESS = 1e6  # capacity factor large enough that the clamps take over
+                # (cap_dest -> shard_n, so the dense return triggers): the
+                # transport is then structurally incapable of clipping
+
+
+def mesh_of(w):
+    assert len(jax.devices()) >= w
+    return Mesh(np.array(jax.devices()[:w]), ("data",))
+
+
+def cfg_pair(method, gran, w, *, factors=(LOSSLESS, LOSSLESS), ef=True,
+             **extra):
+    base = dict(method=method, mode="wire", granularity=gran,
+                error_feedback=ef, bucket_mb=0.004, **extra)
+    return (CompressionConfig(**base),
+            CompressionConfig(transport="sharded", shard_route_factor=factors[0],
+                              shard_return_factor=factors[1], **base))
+
+
+def make_grads(w, n=2048, n2=96, seed=0):
+    k = jax.random.key(seed)
+    return {"a": jax.random.normal(k, (w, n), jnp.float32),
+            "b": jax.random.normal(jax.random.fold_in(k, 1), (w, n2),
+                                   jnp.float32)}
+
+
+def run_both(mesh, cfg_ag, cfg_sh, grads, ef0=None):
+    """One compile: both transports on identical inputs."""
+    w = mesh.shape["data"]
+    sync_ag = make_grad_sync(cfg_ag, "data")
+    sync_sh = make_grad_sync(cfg_sh, "data")
+    use_ef = cfg_ag.error_feedback
+    if ef0 is None:
+        ef0 = jax.tree.map(lambda g: jnp.zeros_like(g), grads)
+
+    def f(g, e):
+        g1 = jax.tree.map(lambda x: x[0], g)
+        e1 = jax.tree.map(lambda x: x[0], e) if use_ef else ()
+        o1, ef1, _, s1 = sync_ag(g1, e1, (), jax.random.key(0))
+        o2, ef2, _, s2 = sync_sh(g1, e1, (), jax.random.key(0))
+        return o1, o2, ef1, ef2, s1, s2
+
+    fn = shard_map(
+        f, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=(P(), P(), P("data") if use_ef else P(),
+                   P("data") if use_ef else P(), P(), P()),
+        check_vma=False)
+    return fn(grads, ef0)
+
+
+# Tier-1 runs the core W=8 Top-K identity (~15 s of dual-transport
+# shard_map compile on the 1-core CI host; this module collects LAST, where
+# a full-suite process pays 2x nominal compile time, so anything more blows
+# the 870 s budget — both longer subsets were measured timing out at 99%);
+# the rest of the method x {2,4,8} x {layerwise,entiremodel,bucketed}
+# matrix carries `slow` and runs in the unfiltered suite.  Granularity
+# grouping itself (group_concat/split) is transport-independent and
+# tier-1-covered by test_dp_sync.
+_QUICK = [("topk", "entiremodel", 8)]
+_SLOW = (
+    [(m, g, 8) for m in ("topk", "blocktopk", "thresholdv")
+     for g in ("layerwise", "bucketed")]
+    + [(m, "entiremodel", 8) for m in ("blocktopk", "thresholdv")]
+    + [(m, "entiremodel", w) for m in ("topk", "blocktopk", "thresholdv")
+       for w in (2, 4)]
+)
+GRID = ([pytest.param(*c, id="-".join(map(str, c))) for c in _QUICK]
+        + [pytest.param(*c, id="-".join(map(str, c)),
+                        marks=pytest.mark.slow) for c in _SLOW])
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("method,gran,w", GRID)
+    def test_matches_allgather_combine(self, method, gran, w):
+        extra = {"ratio": 0.05}
+        if method == "blocktopk":
+            extra["block_size"] = 16
+        if method == "thresholdv":
+            extra = {"threshold": 1.2, "wire_cap_ratio": 0.4}
+        cfg_ag, cfg_sh = cfg_pair(method, gran, w, **extra)
+        grads = make_grads(w)
+        o1, o2, ef1, ef2, s1, s2 = run_both(mesh_of(w), cfg_ag, cfg_sh, grads)
+        for k in o1:
+            np.testing.assert_allclose(
+                np.asarray(o1[k]), np.asarray(o2[k]), atol=1e-6,
+                err_msg=f"synced grad {k} [{method}/{gran}/W={w}]")
+            np.testing.assert_allclose(
+                np.asarray(ef1[k]), np.asarray(ef2[k]), atol=1e-6,
+                err_msg=f"EF residual {k} [{method}/{gran}/W={w}]")
+        # lossless capacities: nothing may clip
+        assert float(s2.get("shard_overflow", 0.0)) == 0.0
+        # and the split is three-way: route on the all_to_all, the shard
+        # return on the all_gather, nothing on the psum ring (no dense
+        # fallback groups in this grid except blocktopk's tiny leaf)
+        assert float(s2["sent_bits_alltoall"]) > 0.0
+        assert float(s2["sent_bits_allgather"]) > 0.0
+        assert float(s1["sent_bits_alltoall"]) == 0.0
+
+
+class TestAcceptance:
+    def test_topk_1pct_w8_per_chip_bits_le_third(self):
+        """ISSUE 2 acceptance: Top-K k=1%, W=8 — analytic AND measured
+        per-chip wire bits under transport='sharded' at the default
+        capacity factors are <= 1/3 of the allgather transport's.
+
+        The allgather side is analytic here (its measured payload is pinned
+        elsewhere: k*64 bits exactly, `sent_bits = 64.0 * ...` asserts in
+        test_wire.py) so tier-1 pays one shard_map compile, not two.
+        """
+        from tpu_compressed_dp.ops.compressors import topk_keep_count
+
+        w, n = 8, 100_000
+        cfg = CompressionConfig(
+            method="topk", mode="wire", granularity="entiremodel",
+            ratio=0.01, error_feedback=True, transport="sharded")
+        sync = make_grad_sync(cfg, "data")
+        grads = {"a": jax.random.normal(jax.random.key(1), (w, n),
+                                        jnp.float32)}
+        ef0 = {"a": jnp.zeros((w, n), jnp.float32)}
+
+        def f(g, e):
+            out, ef, _, st = sync({"a": g["a"][0]}, {"a": e["a"][0]}, (),
+                                  jax.random.key(0))
+            return out, ef, st
+
+        o2, ef2, s2 = shard_map(
+            f, mesh=mesh_of(w), in_specs=(P("data"), P("data")),
+            out_specs=(P(), P("data"), P()), check_vma=False)(grads, ef0)
+
+        keep = topk_keep_count(n, 0.01)
+        ag_chip_bits = (w - 1) * keep * 64.0    # O(W*k) flat combine
+        sh_chip_bits = 8 * per_chip_traffic_bytes(
+            float(s2["sent_bits_psum"]) / 8,
+            float(s2["sent_bits_allgather"]) / 8, w,
+            float(s2["sent_bits_alltoall"]) / 8)
+        assert sh_chip_bits <= ag_chip_bits / 3, (sh_chip_bits, ag_chip_bits)
+        # analytic formula agrees with the measured buffers exactly
+        route_b, ret_b = _sharded_group_bits("topk", n, w, cfg)
+        assert float(s2["sent_bits_alltoall"]) == route_b
+        assert float(s2["sent_bits_allgather"]) == ret_b
+        # the tight default factors DO clip near-disjoint random selections
+        # (the counter is the sizing signal) — but clipping must never lose
+        # mass: transmitted + residual == gradient, exactly
+        assert float(s2["shard_overflow"]) > 0.0
+        recon = jnp.mean(grads["a"] - ef2["a"].reshape(w, n), axis=0)
+        np.testing.assert_allclose(np.asarray(recon), np.asarray(o2["a"]),
+                                   atol=1e-6)
+
+    def test_trend_O_k_plus_n_over_W(self):
+        """Static billing trend: allgather grows linearly in W at fixed k;
+        sharded per-chip bits stay O(k + n/W) — flat-ish in W."""
+        n, keep = 1_000_000, 10_000
+        cfg = CompressionConfig(method="topk", mode="wire", ratio=0.01,
+                                transport="sharded")
+
+        def per_chip(w):
+            route, ret = wire_sharded.sharded_payload_bits(
+                n, keep, w, 1, cfg.shard_route_factor, cfg.shard_return_factor)
+            return (w - 1) / w * route + (w - 1) * ret
+
+        ag = lambda w: (w - 1) * keep * 64.0
+        r8, r64 = per_chip(8) / ag(8), per_chip(64) / ag(64)
+        assert r64 < r8 < 0.35            # advantage grows with W
+        # sharded stays within a small constant of its W=8 value while
+        # allgather's per-chip bits grow ~8x from W=8 to W=64
+        assert per_chip(64) < 2.0 * per_chip(8)
+        assert ag(64) / ag(8) == pytest.approx(9.0, rel=0.01)
+
+
+class TestOverflowAndEF:
+    # the acceptance test above already proves EF conservation under the
+    # default factors' clipping inside tier-1; this forces the degenerate
+    # one-slot caps and runs in the unfiltered suite
+    @pytest.mark.slow
+    def test_clipping_reported_and_ef_conserves_mass(self):
+        w, n = 8, 50_000
+        mesh = mesh_of(w)
+        # absurdly tight caps: one slot per destination, one return slot
+        cfg = CompressionConfig(
+            method="topk", mode="wire", granularity="entiremodel",
+            ratio=0.01, error_feedback=True, transport="sharded",
+            shard_route_factor=8 / (0.01 * n), shard_return_factor=8 / (0.01 * n))
+        sync = make_grad_sync(cfg, "data")
+        grads = {"a": jax.random.normal(jax.random.key(2), (w, n), jnp.float32)}
+        ef0 = {"a": jnp.zeros((w, n), jnp.float32)}
+
+        def f(g, e):
+            out, ef, _, st = sync({"a": g["a"][0]}, {"a": e["a"][0]}, (),
+                                  jax.random.key(0))
+            return out, ef, st
+
+        out, ef, st = shard_map(
+            f, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=(P(), P("data"), P()), check_vma=False)(grads, ef0)
+        assert float(st["shard_overflow"]) > 0.0
+        recon = jnp.mean(grads["a"] - ef["a"].reshape(w, n), axis=0)
+        np.testing.assert_allclose(np.asarray(recon), np.asarray(out["a"]),
+                                   atol=1e-6)
+
+
+class TestClassifier:
+    def test_three_way(self):
+        sh = CompressionConfig(method="topk", mode="wire", transport="sharded")
+        ag = CompressionConfig(method="topk", mode="wire")
+        assert wire_transport("topk", 1000, sh) == "sharded"
+        assert wire_transport("topk", 1000, ag) == "allgather"
+        assert wire_transport("thresholdv", 1000, sh) == "sharded"
+        assert wire_transport("blocktopk", 100_000, sh) == "sharded"
+        # index-free quantizers and psum riders are unaffected by transport
+        assert wire_transport("terngrad", 1000, sh) == "allgather"
+        assert wire_transport("qsgd", 1000, sh) == "allgather"
+        assert wire_transport("none", 1000, sh) == "psum"
+        assert wire_transport("powersgd", 1000, sh) == "psum"
+        rk = CompressionConfig(method="randomk", mode="wire",
+                               transport="sharded")
+        assert wire_transport("randomk", 1000, rk) == "psum"
+        # keep-all blocktopk groups psum dense regardless of transport
+        tiny = CompressionConfig(method="blocktopk", mode="wire",
+                                 transport="sharded", block_size=256)
+        assert wire_transport("blocktopk", 100, tiny) == "psum"
+        assert wire_rides_psum("blocktopk", 100, tiny)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="transport"):
+            CompressionConfig(method="topk", transport="ring")
+        with pytest.raises(ValueError, match="shard_route_factor"):
+            CompressionConfig(method="topk", shard_route_factor=0.0)
+
+
+class TestShardPlan:
+    def test_caps_clamped_and_dense_return_trigger(self):
+        # lossless factors: cap_dest clamps to shard_n, which makes the
+        # sparse return >= the dense shard -> dense_return
+        p = wire_sharded.make_shard_plan(1000, 100, 8, 1, LOSSLESS, LOSSLESS)
+        assert p.shard_n == 125 and p.cap_dest == 100  # min(shard_n, keep)
+        assert p.dense_return
+        # tight factors on a big sparse group: sparse return wins
+        p2 = wire_sharded.make_shard_plan(1_000_000, 10_000, 8, 1, 1.25, 1.25)
+        assert p2.cap_dest == 1563 and p2.cap_ret == 1563
+        assert not p2.dense_return
+        # cap_ret never exceeds what the route can deliver
+        p3 = wire_sharded.make_shard_plan(1_000_000, 10_000, 8, 1, 0.5, 100.0)
+        assert p3.cap_ret <= 8 * p3.cap_dest
+
+    def test_payload_bits_match_plan(self):
+        route, ret = wire_sharded.sharded_payload_bits(
+            1_000_000, 10_000, 8, 1, 1.25, 1.25)
+        p = wire_sharded.make_shard_plan(1_000_000, 10_000, 8, 1, 1.25, 1.25)
+        assert route == p.world * p.cap_dest * 64
+        assert ret == p.cap_ret * 64
+
+
+class TestSimulateCounterfactual:
+    def test_simulate_bills_sharded_buckets(self, mesh8):
+        """mode='simulate' + transport='sharded': the psum stays dense (the
+        paper protocol) but the billing is the sharded wire form's — same
+        static buffer arithmetic as the wire engine's measured bits."""
+        w, n = 8, 10_000
+        cfg = CompressionConfig(method="topk", mode="simulate",
+                                granularity="entiremodel", ratio=0.01,
+                                transport="sharded", shared_mask=False)
+        sync = make_grad_sync(cfg, "data")
+        grads = {"a": jax.random.normal(jax.random.key(0), (w, n), jnp.float32)}
+
+        def f(g):
+            out, _, _, st = sync({"a": g["a"][0]}, (), (), jax.random.key(0))
+            return out, st
+
+        out, st = shard_map(f, mesh=mesh8, in_specs=(P("data"),),
+                            out_specs=(P(), P()), check_vma=False)(grads)
+        route_b, ret_b = _sharded_group_bits("topk", n, w, cfg)
+        assert float(st["sent_bits_alltoall"]) == route_b
+        assert float(st["sent_bits_allgather"]) == ret_b
+        assert float(st["sent_bits"]) == route_b + ret_b
+
+
+def test_packed_indices_monotone_debug_predicate():
+    """ADVICE r5: the sorted/unique scatter hints downstream of
+    packed_indices_from_mask hold only for FINITE gradients.  The debug
+    predicate must certify the invariant on finite input and expose its
+    violation under NaN pollution (NaN >= t is False, the mask underfills,
+    trailing ranks pad with duplicate index 0)."""
+    from tpu_compressed_dp.ops import kernels
+
+    n, keep = 4096, 64
+    g = jax.random.normal(jax.random.key(0), (n,), jnp.float32)
+    mag = jnp.abs(g)
+    t = kernels.topk_threshold(mag, keep)
+    idx = wire.packed_indices_from_mask(mag >= t, keep)
+    assert bool(wire.packed_indices_monotone(idx))
+
+    g_nan = g.at[jnp.argsort(-mag)[: keep // 2]].set(jnp.nan)  # kill top half
+    mag_nan = jnp.abs(g_nan)
+    t_nan = kernels.topk_threshold(mag_nan, keep)
+    mask = mag_nan >= t_nan
+    # NaN slots compare False: the mask can underfill `keep`...
+    if int(jnp.sum(mask)) < keep:
+        idx_nan = wire.packed_indices_from_mask(mask, keep)
+        # ...and the packed indices then violate the hinted invariant —
+        # the documented precondition, not a benign degradation
+        assert not bool(wire.packed_indices_monotone(idx_nan))

@@ -180,12 +180,14 @@ def main(argv=None):
         traffic_scale = (eff / ratio) if eff is not None else 1.0
         psum_b = rec.get("payload_mb_psum", rec.get("payload_mb_per_step", 0.0)) * 1e6
         ag_b = rec.get("payload_mb_allgather", 0.0) * 1e6
+        a2a_b = rec.get("payload_mb_alltoall", 0.0) * 1e6
         if rec.get("transport") == "all_gather" and "payload_mb_psum" not in rec:
             psum_b, ag_b = 0.0, rec["payload_mb_per_step"] * 1e6
         tc_total = epochs * steps_pe * rec["step_ms"] / 1e3  # single-chip s
         physics.append(dict(
             row=row, rec=rec, epochs=epochs, eff=eff,
             traffic_scale=traffic_scale, psum_b=psum_b, ag_b=ag_b,
+            a2a_b=a2a_b,
             tc_total=tc_total))
 
     dense = next((p for p in physics if p["row"]["label"] == args.dense_label),
@@ -197,7 +199,8 @@ def main(argv=None):
 
     def totals(p, w):
         """(total compute seconds at W, total per-chip traffic bytes at W)."""
-        per_step = per_chip_traffic_bytes(p["psum_b"], p["ag_b"], w)
+        per_step = per_chip_traffic_bytes(p["psum_b"], p["ag_b"], w,
+                                          p.get("a2a_b", 0.0))
         return (p["tc_total"] / w,
                 p["epochs"] * steps_pe * per_step * p["traffic_scale"])
 
@@ -222,7 +225,8 @@ def main(argv=None):
 
     cols = ["label", "method", "ratio", "mode", "epochs", "test_acc",
             "converged", "effective_sent_frac", "step_ms_1chip",
-            "payload_mb_psum", "payload_mb_allgather"]
+            "payload_mb_psum", "payload_mb_allgather",
+            "payload_mb_alltoall"]
     for w in WORLDS:
         for name, _ in BANDWIDTHS:
             cols += [f"wall_min_w{w}_{name}", f"speedup_w{w}_{name}"]
@@ -241,6 +245,7 @@ def main(argv=None):
             "step_ms_1chip": p["rec"]["step_ms"],
             "payload_mb_psum": round(p["psum_b"] / 1e6, 4),
             "payload_mb_allgather": round(p["ag_b"] / 1e6, 4),
+            "payload_mb_alltoall": round(p.get("a2a_b", 0.0) / 1e6, 4),
         }
         for w in WORLDS:
             a_m, b_m = totals(p, w)

@@ -41,6 +41,12 @@ CASES = [
     ("topk-1%-wire-EF", "topk", "wire", {"ratio": 0.01, "error_feedback": True}),
     ("blocktopk-1%-wire-EF", "blocktopk", "wire",
      {"ratio": 0.01, "error_feedback": True, "block_size": 256}),
+    # owner-sharded transport: the all_to_all route stage bills at
+    # (W-1)/W x payload per rank, the shard-return all_gather at (W-1) x —
+    # the sent_bits_alltoall bucket closes the measured-vs-analytic loop
+    # for the third collective
+    ("topk-1%-wire-EF-sharded", "topk", "wire",
+     {"ratio": 0.01, "error_feedback": True, "transport": "sharded"}),
     ("terngrad-wire", "terngrad", "wire", {}),
 ]
 
@@ -72,6 +78,7 @@ def worker(args) -> None:
         method=method, granularity="entiremodel", mode=mode,
         ratio=extra.get("ratio", 0.01),
         block_size=extra.get("block_size", 256),
+        transport=extra.get("transport", "allgather"),
         error_feedback=extra.get("error_feedback", False))
     sync = make_grad_sync(cfg, "data")
     mesh = Mesh(np.array(jax.devices()), ("data",))
@@ -134,6 +141,7 @@ def worker(args) -> None:
             "sent_bits": float(stats.get("sent_bits", 0.0)),
             "sent_bits_psum": float(stats.get("sent_bits_psum", 0.0)),
             "sent_bits_allgather": float(stats.get("sent_bits_allgather", 0.0)),
+            "sent_bits_alltoall": float(stats.get("sent_bits_alltoall", 0.0)),
         }
         print("RESULT " + json.dumps(rec), flush=True)
 
@@ -187,13 +195,15 @@ def main(argv=None):
         # analytic: per-rank transmitted bytes/step summed over ranks.
         # Ring all-reduce: each rank transmits 2(W-1)/W x payload;
         # all_gather of worker-distinct payloads: each rank transmits its
-        # own payload (W-1) times.
+        # own payload (W-1) times; all_to_all (the sharded route stage):
+        # each rank keeps its own bucket and transmits (W-1)/W x payload.
         w = args.procs
         psum_b = rec["sent_bits_psum"] / 8.0
         ag_b = rec["sent_bits_allgather"] / 8.0
-        if psum_b == 0.0 and ag_b == 0.0:
+        a2a_b = rec.get("sent_bits_alltoall", 0.0) / 8.0
+        if psum_b == 0.0 and ag_b == 0.0 and a2a_b == 0.0:
             psum_b = rec["sent_bits"] / 8.0
-        per_rank = per_chip_traffic_bytes(psum_b, ag_b, w)
+        per_rank = per_chip_traffic_bytes(psum_b, ag_b, w, a2a_b)
         analytic = per_rank * w
         measured = rec["lo_tx_per_step"]
         rows.append({

@@ -177,7 +177,93 @@ def _pack_scatter_chain(n: int, keep: int, axis_name: str = "data"):
     return chain
 
 
+def _sharded_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
+    """Stage ladder for the OWNER-SHARDED transport (transport='sharded'):
+    mag -> threshold -> pack -> gather -> route (bucket build + all_to_all)
+    -> reduce (owner scatter-add) -> return (shard all_gather + scatter/
+    concat) -> ef.  Mirrors ops/wire_sharded.sharded_combine — update both
+    together.  On one device the collectives are self-copies, so the route/
+    return rungs price the bucketisation and reduction machinery, not link
+    time — the same caveat as the base ladder's all_gather rungs."""
+    from tpu_compressed_dp.ops import wire_sharded
+
+    def chain(flat: jax.Array):
+        mag = jnp.abs(flat).astype(jnp.float32)
+        out = jnp.sum(mag[:8])
+        if upto == "mag":
+            return out
+        t = kernels.topk_threshold(mag, keep)
+        out = out + t
+        if upto == "threshold":
+            return out
+        idx = wire.packed_indices_from_mask(mag >= t, keep)
+        out = out + jnp.sum(idx[:8].astype(jnp.float32))
+        if upto == "pack":
+            return out
+        vals = wire._sorted_gather(flat, idx)
+        out = out + jnp.sum(vals[:8])
+        if upto == "gather":
+            return out
+        world = jax.lax.psum(1, axis_name)
+        plan = wire_sharded.make_shard_plan(
+            n, keep, world, 1, cfg.shard_route_factor, cfg.shard_return_factor)
+        W, cap, shard_n = plan.world, plan.cap_dest, plan.shard_n
+        slot, accepted, dest = wire_sharded._per_dest_slots(idx, None, plan)
+        local = (idx - dest * shard_n).astype(jnp.int32)
+        bvals = jnp.zeros((W * cap + 1,), flat.dtype).at[slot].add(vals)[:-1]
+        bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
+                        ).at[slot].set(local)[:-1]
+        rvals = jax.lax.all_to_all(bvals.reshape(W, cap), axis_name, 0, 0)
+        ridx = jax.lax.all_to_all(bidx.reshape(W, cap), axis_name, 0, 0)
+        out = out + jnp.sum(rvals[0, :8])
+        if upto == "route":
+            return out
+        shard = jnp.zeros((shard_n + 1,), flat.dtype)
+        occ = jnp.zeros((shard_n + 1,), jnp.int32)
+        if W <= 16:
+            for w in range(W):
+                shard = shard.at[ridx[w]].add(
+                    rvals[w], indices_are_sorted=True,
+                    mode="promise_in_bounds")
+                occ = occ.at[ridx[w]].add(
+                    1, indices_are_sorted=True, mode="promise_in_bounds")
+        else:
+            shard = shard.at[ridx.reshape(-1)].add(rvals.reshape(-1))
+            occ = occ.at[ridx.reshape(-1)].add(1)
+        shard, occ = shard[:shard_n], occ[:shard_n]
+        out = out + jnp.sum(shard[:8])
+        if upto == "reduce":
+            return out
+        if plan.dense_return:
+            dense = wire._all_gather(shard, axis_name).reshape(-1)[:n] / world
+        else:
+            mask = occ > 0
+            rix = wire.packed_indices_from_mask(mask, plan.cap_ret)
+            rvalid = (jnp.arange(1, plan.cap_ret + 1, dtype=jnp.int32)
+                      <= jnp.minimum(jnp.sum(mask, dtype=jnp.int32),
+                                     plan.cap_ret))
+            sel = jnp.where(rvalid, shard.at[rix].get(
+                mode="promise_in_bounds"), 0)
+            g_v = wire._all_gather(sel, axis_name)
+            g_i = wire._all_gather(jnp.where(rvalid, rix, 0), axis_name)
+            offs = jnp.arange(W, dtype=jnp.int32)[:, None] * shard_n
+            dense = (jnp.zeros((W * shard_n,), flat.dtype)
+                     .at[(g_i + offs).reshape(-1)].add(g_v.reshape(-1))
+                     [:n] / world)
+        out = out + jnp.sum(dense[:8])
+        if upto == "return":
+            return out
+        new_ef = flat.at[idx].set(0, indices_are_sorted=True,
+                                  unique_indices=True,
+                                  mode="promise_in_bounds")
+        return out + jnp.sum(new_ef[:8])
+
+    return chain
+
+
 STAGES = ["mag", "threshold", "pack", "gather", "combine", "ef"]
+SHARDED_STAGES = ["mag", "threshold", "pack", "gather", "route", "reduce",
+                  "return", "ef"]
 
 
 def time_fn(fn, x, iters: int, warmup_s: float = 3.0):
@@ -203,21 +289,44 @@ def main(argv=None):
                     help="also profile packed_indices_from_mask sub-stages")
     ap.add_argument("--pack2", action="store_true",
                     help="run the (negative-result) full-scatter formulation")
+    ap.add_argument("--transport", default="allgather",
+                    choices=["allgather", "sharded"],
+                    help="profile the flat all_gather combine or the "
+                         "owner-sharded route/reduce/return chain")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="mesh size for the ladder (sharded bucket geometry "
+                         "scales with W; >1 needs forced host devices)")
+    ap.add_argument("--shard_route_factor", type=float, default=1.25)
+    ap.add_argument("--shard_return_factor", type=float, default=1.25)
     args = ap.parse_args(argv)
 
     n = args.n
     keep = compressors.topk_keep_count(n, args.ratio)
-    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    mesh = Mesh(np.array(jax.devices()[:args.devices]), ("data",))
     x = jax.device_put(
         jax.random.normal(jax.random.key(args.seed), (n,), jnp.float32))
 
-    print(f"# wire Top-K stage ladder: n={n} keep={keep} "
-          f"({100*keep/n:.2f}%) device={jax.devices()[0].platform}")
+    if args.transport == "sharded":
+        from tpu_compressed_dp.parallel.dp import CompressionConfig
+
+        cfg = CompressionConfig(
+            method="topk", mode="wire", transport="sharded", ratio=args.ratio,
+            shard_route_factor=args.shard_route_factor,
+            shard_return_factor=args.shard_return_factor)
+        stages = SHARDED_STAGES
+        build = lambda st: _sharded_chain(st, n, keep, cfg)
+    else:
+        stages = STAGES
+        build = lambda st: _stage_chain(st, n, keep)
+
+    print(f"# wire Top-K stage ladder [{args.transport}]: n={n} keep={keep} "
+          f"({100*keep/n:.2f}%) device={jax.devices()[0].platform} "
+          f"W={args.devices}")
     prev = 0.0
     rows = []
-    for st in STAGES:
+    for st in stages:
         fn = jax.jit(shard_map(
-            _stage_chain(st, n, keep),
+            build(st),
             mesh=mesh, in_specs=P(), out_specs=P()))
         dt = time_fn(fn, x, args.iters)
         rows.append((st, dt * 1e3, (dt - prev) * 1e3))

@@ -77,12 +77,15 @@ def run_point(
     method: Optional[str] = None,
     granularity: str = "layerwise",
     mode: str = "simulate",
+    transport: str = "allgather",
     ratio: float = 0.01,
     threshold: float = 1e-3,
     qstates: int = 255,
     block_size: int = 256,
     bucket_mb: float = 25.0,
     wire_cap_ratio: float = 0.05,
+    shard_route_factor: float = 1.25,
+    shard_return_factor: float = 1.25,
     rank: int = 4,
     error_feedback: bool = False,
     batch_size: int = 512,
@@ -112,9 +115,11 @@ def run_point(
     opt = SGD(lr=0.01, momentum=0.9, weight_decay=5e-4)
     cfg = CompressionConfig(
         method=method, granularity=granularity, mode=mode, ratio=ratio,
-        threshold=threshold,
+        threshold=threshold, transport=transport,
         qstates=qstates, block_size=block_size, bucket_mb=bucket_mb,
-        wire_cap_ratio=wire_cap_ratio, rank=rank,
+        wire_cap_ratio=wire_cap_ratio,
+        shard_route_factor=shard_route_factor,
+        shard_return_factor=shard_return_factor, rank=rank,
         error_feedback=error_feedback,
     )
     state = TrainState.create(
@@ -195,11 +200,19 @@ def run_point(
 
         psum_mb = float(metrics.get("comm/sent_bits_psum", 0.0)) / 8 / 1e6
         ag_mb = float(metrics.get("comm/sent_bits_allgather", 0.0)) / 8 / 1e6
-        transport = ("psum" if ag_mb == 0.0
-                     else "all_gather" if psum_mb == 0.0 else "mixed")
+        a2a_mb = float(metrics.get("comm/sent_bits_alltoall", 0.0)) / 8 / 1e6
+        # the collective(s) the wire form rides: a2a > 0 marks the sharded
+        # route stage (its shard return bills as allgather); any psum
+        # alongside it (e.g. keep-all dense-fallback groups) is 'mixed',
+        # matching the pre-sharded classifier's semantics
+        transport_rode = (("sharded" if psum_mb == 0.0 else "mixed")
+                          if a2a_mb > 0.0
+                          else "psum" if ag_mb == 0.0
+                          else "all_gather" if psum_mb == 0.0 else "mixed")
 
         def gbps_per_chip(w: int) -> tuple:
-            comp_gbps = per_chip_traffic_bytes(psum_mb, ag_mb, w) / 1e3 * (steps / dt)
+            comp_gbps = (per_chip_traffic_bytes(psum_mb, ag_mb, w, a2a_mb)
+                         / 1e3 * (steps / dt))
             dense_gbps = per_chip_traffic_bytes(dense_mb, 0.0, w) / 1e3 * (steps / dt)
             return comp_gbps, dense_gbps
 
@@ -208,16 +221,24 @@ def run_point(
             "payload_mb_per_step": round(payload_mb, 4),
             "payload_mb_psum": round(psum_mb, 4),
             "payload_mb_allgather": round(ag_mb, 4),
+            "payload_mb_alltoall": round(a2a_mb, 4),
             "dense_mb_per_step": round(dense_mb, 4),
-            "transport": transport,
+            "transport": transport_rode,
             "sent_frac": round(float(metrics["comm/sent_elems"])
                                / max(float(metrics["comm/dense_elems"]), 1.0), 5),
             "wire_frac": round(float(metrics["comm/sent_bits"])
                                / (32.0 * max(float(metrics["comm/dense_elems"]), 1.0)), 5),
             "allreduce_gbps_per_chip": round(comp_gbps, 3),
             "dense_allreduce_gbps_per_chip": round(dense_gbps, 3),
+            # per-step per-chip link traffic at the RUN's device count —
+            # the rate-free quantity transport comparisons (allgather vs
+            # sharded, BENCH_r07) are made on
+            "per_chip_traffic_mb": round(
+                per_chip_traffic_bytes(psum_mb, ag_mb, ndev, a2a_mb), 4),
             "num_collectives": float(metrics["comm/num_collectives"]),
         })
+        if "comm/shard_overflow" in metrics:
+            record["shard_overflow"] = float(metrics["comm/shard_overflow"])
         # Analytic multi-chip projection (VERDICT r1 weak #6): single-chip
         # sweeps measure step rate but no real collective traffic, leaving
         # the headline "allreduce GB/s vs k" metric empty.  Project the
@@ -242,6 +263,7 @@ def run_sweep(args) -> List[Dict[str, float]]:
     methods = [m.strip() for m in args.methods.split(",") if m.strip()]
     ratios = [float(r) for r in args.ratios.split(",")]
     grans = [g.strip() for g in args.granularities.split(",") if g.strip()]
+    transports = [t.strip() for t in args.transports.split(",") if t.strip()]
     records = []
 
     def emit(rec):
@@ -254,6 +276,8 @@ def run_sweep(args) -> List[Dict[str, float]]:
         devices=args.devices, project_devices=args.project_devices,
         channels_scale=args.channels_scale,
         wire_cap_ratio=args.wire_cap_ratio,
+        shard_route_factor=args.shard_route_factor,
+        shard_return_factor=args.shard_return_factor,
         mode=args.mode, threshold=args.threshold, qstates=args.qstates,
         block_size=args.block_size,
         bucket_mb=args.bucket_mb,
@@ -279,13 +303,22 @@ def run_sweep(args) -> List[Dict[str, float]]:
         kw = common
         if canon in ("terngrad", "qsgd") and args.error_feedback:
             kw = {**common, "error_feedback": False}
+        # the transports axis only differentiates the index-carrying
+        # sparsifiers (wire_transport falls back everywhere else) — other
+        # methods run once, at the first transport
+        from tpu_compressed_dp.ops.wire_sharded import SHARDED_METHODS
+
+        m_transports = (transports if canon in SHARDED_METHODS
+                        else transports[:1])
         for axis, val in pts:
-            label = f"{method}/{gran}" + (
-                f"/k={val}" if axis == "ratio"
-                else f"/r={val}" if axis == "rank" else "")
-            print(f"# {label}", file=sys.stderr)
-            emit(run_point(method=method, granularity=gran,
-                           **({axis: val} if axis else {}), **kw))
+            for tr in m_transports:
+                label = f"{method}/{gran}" + (
+                    f"/k={val}" if axis == "ratio"
+                    else f"/r={val}" if axis == "rank" else "") + (
+                    f"/{tr}" if len(m_transports) > 1 else "")
+                print(f"# {label}", file=sys.stderr)
+                emit(run_point(method=method, granularity=gran, transport=tr,
+                               **({axis: val} if axis else {}), **kw))
     if args.tsv:
         import os
 
@@ -321,6 +354,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ranks", default="1,2,4",
                    help="r values for powersgd (its sweep axis instead of k)")
     p.add_argument("--granularities", default="layerwise,entiremodel")
+    p.add_argument("--transports", default="allgather",
+                   help="comma list of allgather,sharded — the index-carrying"
+                        " sparsifiers run once per transport (sharded = the"
+                        " owner-sharded sparse reduce, O(k + n/W) per chip vs"
+                        " allgather's O(W*k); other methods are unaffected)")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--threshold", type=float, default=1e-3,
                    help="V for thresholdv")
@@ -344,6 +382,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--wire_cap_ratio", type=float, default=0.05,
                    help="wire thresholdv/adaptive_threshold transport "
                         "capacity (fraction of elements)")
+    p.add_argument("--shard_route_factor", type=float, default=1.25,
+                   help="sharded transport per-destination bucket capacity, "
+                        "in units of k/W")
+    p.add_argument("--shard_return_factor", type=float, default=1.25,
+                   help="sharded transport return-union buffer capacity, "
+                        "in units of k/W")
     p.add_argument("--tsv", type=str, default=None)
     return p
 

@@ -151,6 +151,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="post-aggregation L2 clip of the synced gradient "
                         "(bounds the EF residual spike; see tools/ef_bisect.py)")
     p.add_argument("--mode", type=str, default="simulate", choices=["simulate", "wire"])
+    p.add_argument("--transport", default="allgather",
+                   choices=["allgather", "sharded"],
+                   help="wire combine for index-carrying sparsifiers: flat "
+                        "all_gather (O(W*k)/chip) or owner-sharded reduce "
+                        "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
+                        "via comm/shard_overflow)")
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--ratio_warmup_epochs", type=int, default=0,
                    help="DGC-style sparsity warm-up (Lin et al., ICLR'18): "
@@ -337,6 +343,7 @@ def run(args) -> dict:
             block_size=args.block_size,
             bucket_mb=args.bucket_mb,
             wire_cap_ratio=args.wire_cap_ratio,
+            transport=args.transport,
             rank=args.rank,
             error_feedback=args.error_feedback,
         )

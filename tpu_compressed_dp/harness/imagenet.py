@@ -233,6 +233,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket_mb", type=float, default=25.0,
                    help="bucketed granularity: capacity per bucket")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
+    p.add_argument("--transport", default="allgather",
+                   choices=["allgather", "sharded"],
+                   help="wire combine for index-carrying sparsifiers: flat "
+                        "all_gather (O(W*k)/chip) or owner-sharded reduce "
+                        "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
+                        "via comm/shard_overflow)")
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--wire_cap_ratio", type=float, default=0.05,
                    help="wire thresholdv/adaptive_threshold transport "
@@ -333,6 +339,7 @@ def run(args) -> Dict[str, float]:
         qstates=args.qstates, block_size=args.block_size,
         bucket_mb=args.bucket_mb,
         wire_cap_ratio=args.wire_cap_ratio,
+        transport=args.transport,
         rank=args.rank,
         error_feedback=args.error_feedback,
     )
@@ -449,8 +456,9 @@ def run(args) -> Dict[str, float]:
             payload_b = acc.mean("comm/sent_bits") / 8  # bytes per step
             psum_b = acc.mean("comm/sent_bits_psum") / 8 if "comm/sent_bits_psum" in acc.sums else payload_b
             ag_b = acc.mean("comm/sent_bits_allgather") / 8 if "comm/sent_bits_allgather" in acc.sums else 0.0
+            a2a_b = acc.mean("comm/sent_bits_alltoall") / 8 if "comm/sent_bits_alltoall" in acc.sums else 0.0
             steps_done = examples / max(int(pd.cur["bs"]), 1)
-            per_chip_b = per_chip_traffic_bytes(psum_b, ag_b, ndev)
+            per_chip_b = per_chip_traffic_bytes(psum_b, ag_b, ndev, a2a_b)
             tb.log_scalar("net/payload_mb_per_step", payload_b / 1e6)
             tb.log_scalar("net/allreduce_gbps_per_chip",
                           per_chip_b * steps_done / 1e9 / train_time)

@@ -99,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="wire thresholdv/adaptive_threshold transport "
                         "capacity (fraction of elements)")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
+    p.add_argument("--transport", default="allgather",
+                   choices=["allgather", "sharded"],
+                   help="wire combine for index-carrying sparsifiers: flat "
+                        "all_gather (O(W*k)/chip) or owner-sharded reduce "
+                        "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
+                        "via comm/shard_overflow)")
     p.add_argument("--error_feedback", action="store_true")
     # plumbing
     p.add_argument("--seed", type=int, default=0)
@@ -177,6 +183,7 @@ def run(args) -> Dict[str, float]:
         qstates=args.qstates, block_size=args.block_size,
         bucket_mb=args.bucket_mb,
         wire_cap_ratio=args.wire_cap_ratio,
+        transport=args.transport,
         rank=args.rank,
         error_feedback=args.error_feedback,
     )

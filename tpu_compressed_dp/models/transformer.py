@@ -361,12 +361,16 @@ _FUSED_XENT = os.environ.get("TPU_CDP_FUSED_XENT", "")
 _FUSED_XENT_AUTO_BYTES = 1 << 30
 
 
-def use_fused_head_xent(n_tokens: int = 0, vocab: int = 0) -> bool:
+def use_fused_head_xent(n_tokens: int = 0, vocab: int = 0,
+                        itemsize: int = 2) -> bool:
     """Whether the LM loss should take the fused chunked-logsumexp path.
 
     ``n_tokens``/``vocab`` are the per-worker logits dimensions at the call
     site (0 = unknown: auto resolves to off, preserving the pre-r5
-    default for callers that cannot size the buffer).
+    default for callers that cannot size the buffer); ``itemsize`` is the
+    logits dtype width in bytes (``jnp.dtype(cfg.dtype).itemsize`` — fp32
+    configs materialise a 2x larger buffer than the old hardcoded bf16
+    estimate, so the crossover fired at twice the intended size, ADVICE r5).
 
     Requires VMA typing: the custom VJP places its cross-shard cotangent
     psums by diffing primal/cotangent varying-axes (``match_vma``), which
@@ -379,7 +383,7 @@ def use_fused_head_xent(n_tokens: int = 0, vocab: int = 0) -> bool:
         return False
     if _FUSED_XENT in ("0", "1"):
         return _FUSED_XENT == "1"
-    return n_tokens * vocab * 2 > _FUSED_XENT_AUTO_BYTES
+    return n_tokens * vocab * itemsize > _FUSED_XENT_AUTO_BYTES
 
 
 def _fhx_chunks(v_local: int, chunk: int):

@@ -35,6 +35,7 @@ accumulation discipline as XLA's own attention lowering.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -330,8 +331,11 @@ def _bwd(q, k, v, dop, scale, blk, interpret, out_dtype, d):
     # Streamed dkv off-interpret: Q and the packed cotangent stay in HBM,
     # the kernel DMAs per-q-block slices itself (see _dkv_kernel_streamed).
     # Interpret mode (CPU tests) keeps the VMEM-resident form — identical
-    # math via _dkv_block_math.
-    if interpret:
+    # math via _dkv_block_math — unless TPU_CDP_FORCE_STREAMED_DKV=1, which
+    # runs the DMA/double-buffer machinery under the Pallas interpreter so
+    # the streamed path has off-chip parity coverage (ADVICE r5;
+    # tests/test_flash_attention.py::test_streamed_dkv_matches_resident).
+    if interpret and os.environ.get("TPU_CDP_FORCE_STREAMED_DKV") != "1":
         dkv_kernel = functools.partial(_dkv_kernel, scale, bq, bk, t // bq, d)
         qd_specs = [full(d_pad), kv_block, kv_block, full(ds)]
         extra_scratch = []

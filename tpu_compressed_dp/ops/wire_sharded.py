@@ -1,0 +1,274 @@
+"""Owner-sharded sparse-allreduce transport for index-carrying wire payloads.
+
+The flat ``all_gather`` combine (`ops/wire.py`) ships every worker's
+``(value, index)`` pairs to every chip: per-chip wire volume and decode work
+both scale as ``O(W*k)`` — the non-scalable allgather regime that
+"Near-Optimal Sparse Allreduce for Distributed Deep Learning" (OKTopk,
+PAPERS.md) identifies, and that "Understanding Top-k Sparsification" shows
+dominating Top-K's end-to-end cost at scale.  ``transport='sharded'``
+(:class:`~tpu_compressed_dp.parallel.dp.CompressionConfig`) replaces it with
+a sparse reduce-scatter-then-allgather:
+
+  1. **route** — the group's flat unit space (elements, or whole blocks for
+     Block-Top-K) is partitioned into ``W`` contiguous owner shards of
+     ``ceil(n/W)`` units; each worker drops its pairs into fixed-capacity
+     per-destination buckets (``cap_dest`` slots each, zero-value
+     scatter-add-identity padding — the Threshold-V cap-buffer trick) and
+     one ``lax.all_to_all`` delivers bucket ``j`` to owner ``j``.  Pairs
+     beyond a bucket's capacity are *clipped*: they stay in the error
+     feedback residual when EF is on and are dropped (and counted in
+     ``comm/shard_overflow``) when it is off.
+  2. **reduce** — the owner scatter-adds the ``W*cap_dest`` received pairs
+     into its dense shard: cross-worker duplicates collapse *here*, once,
+     instead of ``W`` times on every chip.
+  3. **return** — the reduced shard travels back through one ``all_gather``.
+     Two forms, chosen statically per group by billed size: the compacted
+     *sparse union* of touched units in a ``cap_ret``-capacity buffer
+     (``O(k/W)`` per owner in the high-overlap regime sparsified DP training
+     lives in), or the *dense shard* (``n*32/W`` bits, always lossless)
+     whenever that is no bigger.  Units clipped by ``cap_ret`` are refunded
+     to every contributor's EF residual (each worker checks its accepted
+     coordinates against the returned index set), so return clipping defers
+     gradient mass exactly like any other EF'd drop.
+
+Per-chip wire volume falls from ``(W-1) * k * 64`` bits to
+``~(W-1)/W * route + (W-1) * return`` — ``O(k + min(k, n/W))`` instead of
+``O(W*k)`` — and decode falls from ``W*k`` scatter-adds to ``k`` plus one
+dense concat (dense return) or ``~k`` (sparse return).
+
+Capacity sizing is static config (``shard_route_factor`` /
+``shard_return_factor`` x ``k/W``), so billed bits are static too —
+fixed-size transport is the honest wire cost, exactly as for the
+Threshold-V cap buffer.  ``comm/shard_overflow`` reports how many
+coordinates the caps clipped so they can be sized; the equivalence tests
+(tests/test_wire_sharded.py) run with lossless capacities
+(``cap_dest = shard_n`` forces the dense return) and match the allgather
+combine bit-for-bit up to fp32 summation order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["ShardPlan", "make_shard_plan", "sharded_payload_bits",
+           "sharded_combine", "SHARDED_METHODS"]
+
+# The wire methods whose payloads carry explicit indices and therefore have
+# a sharded form.  Quantizers (terngrad/qsgd) ship dense per-worker codes
+# with per-worker scales — there is no (value, index) stream to route — and
+# psum-riding methods (shared-seed randomk, powersgd, keep-all blocktopk)
+# already reduce on the ring.
+SHARDED_METHODS = ("topk", "blocktopk", "thresholdv", "adaptive_threshold")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """Static geometry of one group's sharded combine.
+
+    ``n_units``/``keep`` count *units*: elements for the element-granular
+    methods, whole blocks for Block-Top-K (``unit_size > 1``).
+    """
+
+    n_units: int       # units in the group's flat space
+    keep: int          # payload slots per worker (k, kb, or the cap)
+    world: int         # W (static mesh size)
+    unit_size: int     # elements per unit (1, or block_size)
+    shard_n: int       # units per owner shard (ceil(n_units / W))
+    cap_dest: int      # route: slots per destination bucket
+    cap_ret: int       # return: sparse-union buffer capacity per owner
+    dense_return: bool # return the dense shard instead of the sparse union
+
+
+def make_shard_plan(n_units: int, keep: int, world: int, unit_size: int,
+                    route_factor: float, return_factor: float) -> ShardPlan:
+    """Size the fixed-capacity buffers for one group, statically.
+
+    ``cap_dest = route_factor * keep / W`` assumes a worker's selections
+    spread roughly uniformly over the owner shards; ``cap_ret =
+    return_factor * keep / W`` assumes worker selections overlap (the
+    premise sparsified DP training rests on — correlated gradients select
+    correlated coordinates; OKTopk makes the same bet).  Both caps are
+    clamped to their lossless bounds (a worker holds at most ``shard_n``
+    distinct units per shard; the union at an owner holds at most
+    ``W * cap_dest``), and the sparse return is swapped for the dense shard
+    whenever the dense form is no bigger — which is also how generous
+    factors (tests) force the always-lossless dense path.
+    """
+    shard_n = -(-n_units // world)
+    cap_dest = max(1, -(-int(round(route_factor * keep)) // world))
+    cap_dest = min(cap_dest, shard_n, max(keep, 1))
+    cap_ret = max(1, -(-int(round(return_factor * keep)) // world))
+    cap_ret = min(cap_ret, world * cap_dest, shard_n)
+    # sparse unit = unit_size values + 1 index word; dense unit = unit_size
+    # values.  Prefer dense at equality: it is lossless.
+    sparse_bits = cap_ret * 32 * (unit_size + 1)
+    dense_bits = shard_n * 32 * unit_size
+    return ShardPlan(n_units, keep, world, unit_size, shard_n, cap_dest,
+                     cap_ret, dense_bits <= sparse_bits)
+
+
+def sharded_payload_bits(n_units: int, keep: int, world: int, unit_size: int,
+                         route_factor: float, return_factor: float
+                         ) -> Tuple[float, float]:
+    """Analytic ``(route_bits, return_bits)`` per chip for one group —
+    the same arithmetic the wire engine measures off its actual buffers
+    (fp32 values assumed, matching the analytic convention everywhere
+    else).  Route bits ride ``all_to_all`` (per-chip link traffic
+    ``(W-1)/W x``); return bits ride ``all_gather`` (``(W-1) x``)."""
+    p = make_shard_plan(n_units, keep, world, unit_size, route_factor,
+                        return_factor)
+    route = float(p.world * p.cap_dest * 32 * (unit_size + 1))
+    if p.dense_return:
+        ret = float(p.shard_n * 32 * unit_size)
+    else:
+        ret = float(p.cap_ret * 32 * (unit_size + 1))
+    return route, ret
+
+
+def _per_dest_slots(idx: Array, valid: Optional[Array], plan: ShardPlan
+                    ) -> Tuple[Array, Array, Array]:
+    """Assign each payload slot its route bucket position.
+
+    ``idx`` is ascending (``packed_indices_from_mask`` order), so
+    destinations are ascending too and the within-destination rank is just
+    ``position - first position of that destination``.  Returns
+    ``(slot, accepted, dest)``: ``slot`` indexes the flat
+    ``[W*cap_dest]`` bucket buffer (clipped/invalid slots point at the
+    dump slot ``W*cap_dest``, sliced off before the collective).
+    """
+    k = idx.shape[0]
+    W, cap = plan.world, plan.cap_dest
+    dest = jnp.minimum(idx // plan.shard_n, W - 1).astype(jnp.int32)
+    if valid is not None:
+        # invalid (zero-padded cap-buffer) slots must not consume shard-0
+        # bucket capacity: park them in the dump destination W.  Valid slots
+        # are a prefix (fixed-capacity packing), so dest stays ascending.
+        dest = jnp.where(valid, dest, W)
+    counts = jnp.zeros((W + 1,), jnp.int32).at[dest].add(
+        1, indices_are_sorted=True, mode="promise_in_bounds")
+    starts = jnp.cumsum(counts) - counts              # exclusive prefix
+    rank = jnp.arange(k, dtype=jnp.int32) - starts[dest]
+    accepted = rank < cap
+    if valid is not None:
+        accepted = accepted & valid
+    slot = jnp.where(accepted, dest * cap + rank, W * cap)
+    return slot, accepted, dest
+
+
+def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
+                    axis_name: str, valid: Optional[Array] = None):
+    """Route -> owner-reduce -> return one group's ``(values, indices)``
+    payload; must run inside ``shard_map`` over ``axis_name``.
+
+    ``vals``: ``[keep]`` (element units) or ``[keep, unit_size]`` (block
+    units); ``idx``: ``[keep]`` ascending int32 unit indices; ``valid``:
+    optional ``[keep]`` bool marking real (non-padding) slots — a *prefix*
+    of the buffer, as the fixed-capacity packing produces.
+
+    Returns ``(dense_units, sent, route_bits, return_bits, overflow)``:
+
+    * ``dense_units`` — the cross-worker **sum** over the padded unit space
+      ``[W*shard_n(, unit_size)]`` (caller divides by world and slices);
+    * ``sent`` — ``[keep]`` bool: slots that were routed AND returned, i.e.
+      exactly the coordinates the synced gradient contains — ``~sent``
+      survivors belong in the EF residual;
+    * ``route_bits``/``return_bits`` — measured payload bits of the arrays
+      handed to ``all_to_all`` / ``all_gather`` (one worker's share);
+    * ``overflow`` — this worker's route-clipped count plus this owner's
+      return-clipped union count (psum for the global figure).
+    """
+    from tpu_compressed_dp.ops.wire import (_all_gather, _payload_bits,
+                                            packed_indices_from_mask)
+
+    W, cap, shard_n = plan.world, plan.cap_dest, plan.shard_n
+    blocky = vals.ndim == 2
+    slot, accepted, dest = _per_dest_slots(idx, valid, plan)
+    local = (idx - dest * shard_n).astype(jnp.int32)
+
+    # --- route: fixed [W, cap_dest] buckets, one all_to_all -------------
+    # Empty slots carry (value 0, local index shard_n): shard_n is one past
+    # the owner's unit range, so the owner's accumulators get one guard row
+    # that is sliced off — padding can neither perturb a real unit nor
+    # inflate the occupancy counts the return union and overflow counter
+    # are built from — and the constant tail keeps every bucket row
+    # monotone (filled ascending prefix) so the owner's per-row scatter
+    # keeps its sorted hint.  Clipped/invalid payload slots all target the
+    # dump slot W*cap, sliced off before the collective, so their values
+    # need no masking.
+    bvals = jnp.zeros((W * cap + 1,) + vals.shape[1:], vals.dtype
+                      ).at[slot].add(vals)[:-1]
+    bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
+                    ).at[slot].set(local)[:-1]
+    bvals = bvals.reshape((W, cap) + vals.shape[1:])
+    bidx = bidx.reshape(W, cap)
+    route_bits = _payload_bits(bvals, bidx)
+    rvals = jax.lax.all_to_all(bvals, axis_name, 0, 0)   # [W, cap(, bs)]
+    ridx = jax.lax.all_to_all(bidx, axis_name, 0, 0)
+
+    # --- owner reduce: W*cap scatter-adds into the dense shard ----------
+    # shard_n + 1 rows: the last is the padding guard row, sliced off
+    shard = jnp.zeros((shard_n + 1,) + vals.shape[1:], vals.dtype)
+    occ = jnp.zeros((shard_n + 1,), jnp.int32)
+    if W <= 16:
+        # per-row scatters keep the sorted hint alive (rows are monotone by
+        # construction); same compile-size guard as wire._scatter_combine
+        for w in range(W):
+            shard = shard.at[ridx[w]].add(
+                rvals[w], indices_are_sorted=True, mode="promise_in_bounds")
+            occ = occ.at[ridx[w]].add(
+                1, indices_are_sorted=True, mode="promise_in_bounds")
+    else:
+        flat_i = ridx.reshape(-1)
+        shard = shard.at[flat_i].add(
+            rvals.reshape((-1,) + vals.shape[1:]))
+        occ = occ.at[flat_i].add(1)
+    shard, occ = shard[:shard_n], occ[:shard_n]
+
+    route_overflow = (jnp.sum(valid, dtype=jnp.int32) if valid is not None
+                      else jnp.int32(idx.shape[0])
+                      ) - jnp.sum(accepted, dtype=jnp.int32)
+
+    # --- return ---------------------------------------------------------
+    if plan.dense_return:
+        g = _all_gather(shard, axis_name)                # [W, shard_n(, bs)]
+        dense = g.reshape((W * shard_n,) + vals.shape[1:])
+        return_bits = _payload_bits(shard)
+        sent = accepted
+        overflow = route_overflow
+        return dense, sent, route_bits, return_bits, overflow
+
+    cap_ret = plan.cap_ret
+    mask = occ > 0
+    nnz = jnp.sum(mask, dtype=jnp.int32)
+    rix = packed_indices_from_mask(mask, cap_ret)
+    rvalid = jnp.arange(1, cap_ret + 1, dtype=jnp.int32) <= jnp.minimum(
+        nnz, cap_ret)
+    # no sorted hint: when the union underfills cap_ret the pack pads
+    # trailing ranks with index 0, breaking monotonicity
+    sel = shard.at[rix].get(mode="promise_in_bounds")
+    sel = jnp.where(rvalid[(...,) + (None,) * (vals.ndim - 1)], sel, 0)
+    rix = jnp.where(rvalid, rix, 0)
+    return_bits = _payload_bits(sel, rix)
+    g_vals = _all_gather(sel, axis_name)                 # [W, cap_ret(, bs)]
+    g_rix = _all_gather(rix, axis_name)                  # [W, cap_ret]
+    offs = jnp.arange(W, dtype=jnp.int32)[:, None] * shard_n
+    gidx = (g_rix + offs).reshape(-1)
+    dense = jnp.zeros((W * shard_n,) + vals.shape[1:], vals.dtype
+                      ).at[gidx].add(
+                          g_vals.reshape((-1,) + vals.shape[1:]))
+    # Which of MY accepted coordinates actually came back: units the owner
+    # clipped must return to the EF residual (their contributors zeroed
+    # them locally but the synced gradient does not contain them).  No
+    # sorted hint here: zero-padded cap buffers (thresholdv) have index 0
+    # in their tail slots, so ``idx`` is only ascending over its valid
+    # prefix.
+    returned = jnp.zeros((W * shard_n,), jnp.uint8).at[gidx].set(1)
+    sent = accepted & (returned.at[idx].get(mode="promise_in_bounds") > 0)
+    overflow = route_overflow + jnp.maximum(nnz - cap_ret, 0)
+    return dense, sent, route_bits, return_bits, overflow

@@ -50,33 +50,74 @@ from tpu_compressed_dp.ops import compressors, kernels
 __all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
            "make_leaf_groups", "group_concat", "group_split", "init_ef_state",
            "init_comp_state", "init_comp_state_partitioned",
-           "init_comp_state_grouped", "make_sharded_clip", "wire_rides_psum"]
+           "init_comp_state_grouped", "make_sharded_clip", "wire_rides_psum",
+           "wire_transport"]
+
+
+def wire_transport(name: str, n: int, cfg: "CompressionConfig") -> str:
+    """Which collective the method's WIRE form rides for an ``n``-element
+    group (VERDICT r2 #2): ``'psum'`` | ``'allgather'`` | ``'sharded'`` —
+    the single source of truth for the ``sent_bits_psum`` /
+    ``sent_bits_allgather`` / ``sent_bits_alltoall`` split in BOTH sync
+    engines.
+
+    Dense and SHARED-seed Random-K psum-reduce a (packed) buffer — per-chip
+    ring traffic ``2(W-1)/W x payload``; PowerSGD's P/Q factors are linear
+    in the gradient and always psum; Block-Top-K keep-all groups fall back
+    to a dense psum.  Every other method's payloads are worker-distinct
+    (indices or quantizer scales differ): by default they ride an
+    all_gather — per-chip traffic ``~(W-1) x payload``, i.e. ``O(W*k)``.
+    ``cfg.transport='sharded'`` moves the index-carrying sparsifiers
+    (:data:`~tpu_compressed_dp.ops.wire_sharded.SHARDED_METHODS`) onto the
+    owner-sharded reduce instead: all_to_all route (``(W-1)/W x``) plus a
+    shard-return all_gather — ``O(k + n/W)`` per chip.  Quantizers carry no
+    indices to route and keep the all_gather regardless.  Per-rank-mask
+    Random-K (simulate default, the unseeded CIFAR harness) ships
+    worker-distinct indices too — all_gather, matching its own 64-bit
+    accounting.
+    """
+    if name == "none" or (name == "randomk" and cfg.resolved_shared_mask):
+        return "psum"
+    if name == "powersgd":
+        return "psum"
+    if name == "blocktopk":
+        kb = compressors.blocktopk_keep_blocks(n, cfg.ratio, cfg.block_size)
+        if kb * cfg.block_size >= n:
+            return "psum"
+    if cfg.transport == "sharded":
+        from tpu_compressed_dp.ops.wire_sharded import SHARDED_METHODS
+
+        if name in SHARDED_METHODS:
+            return "sharded"
+    return "allgather"
 
 
 def wire_rides_psum(name: str, n: int, cfg: "CompressionConfig") -> bool:
-    """Which collective the method's WIRE form rides for an ``n``-element
-    group (VERDICT r2 #2) — the single source of truth for the
-    ``sent_bits_psum`` / ``sent_bits_allgather`` split in BOTH sync engines.
+    """Back-compat predicate over :func:`wire_transport`."""
+    return wire_transport(name, n, cfg) == "psum"
 
-    Dense and SHARED-seed Random-K psum-reduce a (packed) buffer — per-chip
-    ring traffic ``2(W-1)/W x payload``; every other method's payloads are
-    worker-distinct (indices or quantizer scales differ) and ride an
-    all_gather — per-chip traffic ``~(W-1) x payload``.  Per-rank-mask
-    Random-K (simulate default, the unseeded CIFAR harness) ships
-    worker-distinct indices too — all_gather, matching its own 64-bit
-    accounting.  Block-Top-K keep-all groups fall back to a dense psum.
-    """
-    if name == "none" or (name == "randomk" and cfg.resolved_shared_mask):
-        return True
-    if name == "powersgd":
-        # the factors P and Q are linear in the gradient — per-worker payloads
-        # sum meaningfully, so they always psum (ops/lowrank.py); dense
-        # fallback groups psum trivially
-        return True
+
+def _sharded_group_bits(name: str, n: int, world: int,
+                        cfg: "CompressionConfig"):
+    """Analytic ``(route_bits, return_bits)`` of the sharded wire form for
+    an ``n``-element group — the per-method unit geometry feeding
+    :func:`~tpu_compressed_dp.ops.wire_sharded.sharded_payload_bits` (whose
+    result equals the wire engine's measured fp32 buffer bits, so simulate
+    and wire accounting agree for the sharded transport too)."""
+    from tpu_compressed_dp.ops import wire_sharded
+
     if name == "blocktopk":
         kb = compressors.blocktopk_keep_blocks(n, cfg.ratio, cfg.block_size)
-        return kb * cfg.block_size >= n
-    return False
+        nb = -(-n // cfg.block_size)
+        return wire_sharded.sharded_payload_bits(
+            nb, kb, world, cfg.block_size,
+            cfg.shard_route_factor, cfg.shard_return_factor)
+    if name in ("thresholdv", "adaptive_threshold"):
+        keep = max(1, int(round(cfg.wire_cap_ratio * n)))
+    else:
+        keep = compressors.topk_keep_count(n, cfg.ratio)
+    return wire_sharded.sharded_payload_bits(
+        n, keep, world, 1, cfg.shard_route_factor, cfg.shard_return_factor)
 
 
 def make_partitioned_clip(leaf_axes):
@@ -146,6 +187,16 @@ class CompressionConfig:
                    matching the reference)
     mode:          'simulate' (dense payload, paper protocol) or 'wire'
                    (packed sparse payload)
+    transport:     'allgather' (flat combine: every worker's (value, index)
+                   pairs visit every chip, O(W*k) per chip) or 'sharded'
+                   (owner-sharded sparse reduce, ops/wire_sharded.py:
+                   all_to_all route to contiguous shard owners, owner
+                   scatter-add, shard-return all_gather — O(k + n/W) per
+                   chip).  Applies to the index-carrying sparsifiers
+                   (topk/blocktopk/thresholdv/adaptive_threshold); psum
+                   riders and the index-free quantizers are unaffected.
+                   Capacity knobs: shard_route_factor/shard_return_factor
+                   (x k/W slots); clips fold into EF / comm/shard_overflow.
     ratio:         K for topk/randomk (`--ratio`, default 0.5)
     threshold:     V for thresholdv (`--threshold`, default 1e-3)
     qstates:       quantisation states for qsgd (`--qstates`, default 255)
@@ -173,6 +224,16 @@ class CompressionConfig:
     method: Optional[str] = None
     granularity: str = "layerwise"
     mode: str = "simulate"
+    # transport: which collective carries index-carrying wire payloads.
+    # 'allgather' — every worker's (value, index) pairs visit every chip:
+    # per-chip volume/decode O(W*k), fine at small W.  'sharded' — the
+    # owner-sharded sparse reduce (ops/wire_sharded.py): pairs route to
+    # contiguous shard owners via all_to_all, owners reduce, shards return
+    # via one all_gather — O(k + n/W) per chip, the scalable regime
+    # (OKTopk, PAPERS.md).  Applies to topk/blocktopk/thresholdv/
+    # adaptive_threshold; psum-riding methods and the index-free quantizers
+    # are unaffected (see wire_transport).
+    transport: str = "allgather"
     ratio: float = 0.5
     threshold: float = 1e-3
     qstates: int = 255
@@ -189,6 +250,15 @@ class CompressionConfig:
     # Overflowing survivors stay in the EF residual (or are dropped, EF off);
     # comm/threshold_overflow reports the clip count.
     wire_cap_ratio: float = 0.05
+    # sharded transport capacity factors, in units of the per-shard fair
+    # share k/W.  Route: per-destination bucket = route_factor * k/W slots
+    # (uniform-spread assumption; skew clips into EF / shard_overflow).
+    # Return: sparse-union buffer = return_factor * k/W units (worker
+    # selections overlap — the premise compression rests on; the buffer is
+    # clamped to its lossless bound W*cap_dest and to the shard size, and
+    # the dense shard returns instead whenever that bills no bigger).
+    shard_route_factor: float = 1.25
+    shard_return_factor: float = 1.25
     # terngrad: elements per scale chunk (0 = single global max; -1 = auto).
     # A single max over an entire-model gradient drives keep-probabilities
     # toward zero and the estimator variance unbounded (the r2 NaN row); one
@@ -210,6 +280,15 @@ class CompressionConfig:
             raise ValueError(f"bucket_mb must be positive, got {self.bucket_mb}")
         if self.mode not in ("simulate", "wire"):
             raise ValueError(f"mode must be simulate|wire, got {self.mode!r}")
+        if self.transport not in ("allgather", "sharded"):
+            raise ValueError(
+                f"transport must be allgather|sharded, got {self.transport!r}")
+        if self.shard_route_factor <= 0 or self.shard_return_factor <= 0:
+            raise ValueError(
+                "shard_route_factor/shard_return_factor must be positive, "
+                f"got {self.shard_route_factor}/{self.shard_return_factor} "
+                "(they scale the fixed per-destination and return-union "
+                "buffer capacities; 0 would allocate no transport at all)")
         if not (0.0 < self.wire_cap_ratio <= 1.0):
             raise ValueError(
                 f"wire_cap_ratio must be in (0, 1], got {self.wire_cap_ratio} "
@@ -464,9 +543,6 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         k = compressors.leaf_key(key, index, per_worker_rng and comp.needs_rng, axis_name)
         return comp.fn(flat, k)
 
-    def rides_psum(n_g: int) -> bool:
-        return wire_rides_psum(comp.name, n_g, cfg)
-
     def sync(grads: Any, ef: Any, comp_state: Any, key: jax.Array
              ) -> Tuple[Any, Any, Any, Dict[str, jax.Array]]:
         world = jax.lax.psum(1, axis_name)
@@ -488,6 +564,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         bits_total = jnp.asarray(0.0, jnp.float32)
         bits_psum = jnp.asarray(0.0, jnp.float32)
         bits_ag = jnp.asarray(0.0, jnp.float32)
+        bits_a2a = jnp.asarray(0.0, jnp.float32)
         dense_total = 0.0
         for gi, idxs in enumerate(groups):
             flat = group_concat(leaves, idxs)
@@ -516,12 +593,23 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             if use_ef:
                 group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
                             dtype=jnp.float32)
-            sent_total = sent_total + group_sent
-            bits_total = bits_total + group_bits
-            if rides_psum(n_g):
+            transport = wire_transport(comp.name, n_g, cfg)
+            if transport == "sharded" and world > 1:
+                # counterfactual like the rest of simulate billing: bill the
+                # fixed-capacity route/return buffers the sharded wire form
+                # WOULD move (static, like the wire engine's measured bits).
+                # W=1 matches the wire engine's degradation to the allgather
+                # combine (below), keeping the two engines' accounting equal.
+                route_b, ret_b = _sharded_group_bits(comp.name, n_g, world, cfg)
+                group_bits = jnp.asarray(route_b + ret_b, jnp.float32)
+                bits_a2a = bits_a2a + route_b
+                bits_ag = bits_ag + ret_b
+            elif transport == "psum":
                 bits_psum = bits_psum + group_bits
             else:
                 bits_ag = bits_ag + group_bits
+            sent_total = sent_total + group_sent
+            bits_total = bits_total + group_bits
             dense_total += float(n_g)
 
         out = jax.tree.unflatten(treedef, out_leaves)
@@ -531,6 +619,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             "sent_bits": bits_total,
             "sent_bits_psum": bits_psum,
             "sent_bits_allgather": bits_ag,
+            "sent_bits_alltoall": bits_a2a,
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
             "num_collectives": jnp.asarray(float(len(groups)), jnp.float32),
         }
@@ -640,6 +729,7 @@ def _make_powersgd_sync(cfg: CompressionConfig, axis_name):
             "sent_bits": jnp.asarray(bits_total, jnp.float32),
             "sent_bits_psum": jnp.asarray(bits_total, jnp.float32),
             "sent_bits_allgather": jnp.asarray(0.0, jnp.float32),
+            "sent_bits_alltoall": jnp.asarray(0.0, jnp.float32),
             "dense_elems": jnp.asarray(dense_total, jnp.float32),
             "num_collectives": jnp.asarray(float(n_coll), jnp.float32),
         }

@@ -191,8 +191,10 @@ def make_lm_train_step(
 
         def loss_fn(params):
             # per-worker logits buffer: local tokens x vocab shard (V/tp)
+            # at the config's logits width (bf16 OR fp32 — ADVICE r5)
             if use_fused_head_xent(x.shape[0] * x.shape[1],
-                                   cfg.vocab_size // mesh.shape["tensor"]):
+                                   cfg.vocab_size // mesh.shape["tensor"],
+                                   jnp.dtype(cfg.dtype).itemsize):
                 # head matmul + softmax-xent fused through a chunked running
                 # logsumexp: the [B,T,V] logits (and AD's saved softmax
                 # inputs) never materialise in HBM

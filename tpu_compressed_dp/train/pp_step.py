@@ -337,7 +337,8 @@ def make_pp_train_step(
                 my_y = compat.pcast(ys, ("pipe",), to="varying")
             hn = _rms_norm(my_h.reshape(m_s * mb, t_len, cfg.dim),
                            params["final_norm"], cfg.norm_eps)
-            if use_fused_head_xent(m_s * mb * t_len, cfg.vocab_size // tp):
+            if use_fused_head_xent(m_s * mb * t_len, cfg.vocab_size // tp,
+                                   jnp.dtype(cfg.dtype).itemsize):
                 nll = fused_head_xent(hn, params["lm_head"].astype(dt),
                                       my_y.reshape(m_s * mb, t_len),
                                       tensor_axis)

@@ -25,21 +25,26 @@ __all__ = ["TimeMeter", "NetworkMeter", "CommMeter", "network_bytes",
 
 
 def per_chip_traffic_bytes(psum_bytes: float, allgather_bytes: float,
-                           world: int) -> float:
+                           world: int, alltoall_bytes: float = 0.0) -> float:
     """Per-chip link traffic for one gradient sync at ``world`` chips.
 
     The single source of the method-aware transport arithmetic (VERDICT r2
-    #2), shared by bench/sweep.py and the ImageNet harness so they can never
-    report different numbers for the same run: a ring psum moves
-    ``2(W-1)/W x payload`` through each chip's links; an all_gather of
-    worker-distinct payloads moves ``(W-1) x payload`` per chip (every
-    worker's packet visits every other chip).  The sync engines report the
-    split as ``comm/sent_bits_psum`` / ``comm/sent_bits_allgather``.  This is
-    the analytic analog of the reference's NIC-byte measurement
-    (`IMAGENET/training/meter.py:24-47`).
+    #2), shared by bench/sweep.py, the ImageNet harness and
+    tools/validate_transport.py so they can never report different numbers
+    for the same run: a ring psum moves ``2(W-1)/W x payload`` through each
+    chip's links; an all_gather of worker-distinct payloads moves
+    ``(W-1) x payload`` per chip (every worker's packet visits every other
+    chip); an all_to_all moves ``(W-1)/W x payload`` per chip (each worker
+    keeps its own ``1/W`` bucket locally and sends one bucket to each peer
+    — the sharded transport's route stage, whose shard-return all_gather
+    bills in the allgather bucket).  The sync engines report the split as
+    ``comm/sent_bits_psum`` / ``comm/sent_bits_allgather`` /
+    ``comm/sent_bits_alltoall``.  This is the analytic analog of the
+    reference's NIC-byte measurement (`IMAGENET/training/meter.py:24-47`).
     """
     ring = 2 * (world - 1) / max(world, 1)
-    return ring * psum_bytes + (world - 1) * allgather_bytes
+    return (ring * psum_bytes + (world - 1) * allgather_bytes
+            + (world - 1) / max(world, 1) * alltoall_bytes)
 
 
 class TimeMeter:
