@@ -1,0 +1,458 @@
+"""In-graph step guard: finiteness vote, skip/hold semantics, dynamic loss
+scaling, chaos injection, and the Orbax round-trip of the new guard state
+(ISSUE 3 tentpole)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.parallel.dp import (CompressionConfig, init_comp_state,
+                                           init_ef_state)
+from tpu_compressed_dp.train import guard as guard_mod
+from tpu_compressed_dp.train.guard import (GuardConfig, GuardExceeded,
+                                           GuardState, check_guard_metrics,
+                                           init_guard_state, update_guard)
+from tpu_compressed_dp.train.optim import SGD
+from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.train.step import make_train_step
+from tpu_compressed_dp.utils.chaos import ChaosConfig
+
+pytestmark = pytest.mark.quick
+
+
+# ------------------------------------------------------------- pure units
+
+class TestGuardConfig:
+    def test_for_dtype_activates_scaling_on_16bit(self):
+        assert GuardConfig.for_dtype(jnp.bfloat16).loss_scaling
+        assert GuardConfig.for_dtype(jnp.float16).loss_scaling
+        assert not GuardConfig.for_dtype(jnp.float32).loss_scaling
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="backoff"):
+            GuardConfig(backoff=1.5)
+        with pytest.raises(ValueError, match="growth "):
+            GuardConfig(growth=0.5)
+        with pytest.raises(ValueError, match="init_scale"):
+            GuardConfig(init_scale=0.25)
+
+    def test_init_state_identity_scale_when_scaling_off(self):
+        gs = init_guard_state(GuardConfig(loss_scaling=False))
+        assert float(gs.loss_scale) == 1.0
+        assert init_guard_state(None) == ()
+
+
+class TestUpdateGuard:
+    def _gs(self, **kw):
+        base = dict(loss_scale=jnp.asarray(512.0), good_steps=jnp.asarray(0),
+                    skips=jnp.asarray(0), total_skipped=jnp.asarray(0),
+                    last_good_step=jnp.asarray(0))
+        base.update({k: jnp.asarray(v) for k, v in kw.items()})
+        return GuardState(**base)
+
+    def test_backoff_clamps_at_one(self):
+        cfg = GuardConfig(backoff=0.5, loss_scaling=True)
+        gs = self._gs(loss_scale=1.5)
+        gs = update_guard(cfg, gs, jnp.asarray(False), jnp.asarray(1))
+        assert float(gs.loss_scale) == 1.0
+        gs = update_guard(cfg, gs, jnp.asarray(False), jnp.asarray(2))
+        assert float(gs.loss_scale) == 1.0  # never below 1
+        assert int(gs.skips) == 2 and int(gs.total_skipped) == 2
+
+    def test_growth_after_interval_and_counter_reset(self):
+        cfg = GuardConfig(growth_interval=2, growth=2.0, loss_scaling=True)
+        gs = self._gs()
+        gs = update_guard(cfg, gs, jnp.asarray(True), jnp.asarray(1))
+        assert float(gs.loss_scale) == 512.0 and int(gs.good_steps) == 1
+        gs = update_guard(cfg, gs, jnp.asarray(True), jnp.asarray(2))
+        assert float(gs.loss_scale) == 1024.0 and int(gs.good_steps) == 0
+        assert int(gs.last_good_step) == 2
+
+    def test_bad_step_resets_growth_progress(self):
+        cfg = GuardConfig(growth_interval=2, loss_scaling=True)
+        gs = self._gs(good_steps=1)
+        gs = update_guard(cfg, gs, jnp.asarray(False), jnp.asarray(5))
+        assert int(gs.good_steps) == 0
+        assert int(gs.last_good_step) == 0  # unchanged
+
+    def test_pinned_scale_when_scaling_off(self):
+        cfg = GuardConfig(loss_scaling=False)
+        gs = self._gs(loss_scale=1.0)
+        for ok in (False, True, True, True):
+            gs = update_guard(cfg, gs, jnp.asarray(ok), jnp.asarray(1))
+        assert float(gs.loss_scale) == 1.0
+
+
+class TestHostCheck:
+    def test_raises_past_max(self):
+        cfg = GuardConfig(max_consecutive_skips=3)
+        check_guard_metrics({"guard/skip_streak": 3.0}, cfg)  # at the limit: ok
+        with pytest.raises(GuardExceeded, match="4 consecutive"):
+            check_guard_metrics(
+                {"guard/skip_streak": 4.0, "guard/loss_scale": 8.0,
+                 "guard/last_good_step": 11.0}, cfg)
+
+    def test_noop_without_guard_metrics(self):
+        check_guard_metrics({"loss": 1.0}, GuardConfig())
+
+
+class TestChaosParse:
+    def test_full_spec(self):
+        c = ChaosConfig.parse("inf,target=loss,steps=3+7,worker=2,crash=40")
+        assert c.kind == "inf" and c.target == "loss"
+        assert c.steps == (3, 7) and c.worker == 2 and c.crash_at_step == 40
+        assert c.injects_in_graph
+
+    def test_crash_only(self):
+        c = ChaosConfig.parse("crash=10")
+        assert not c.injects_in_graph and c.crash_at_step == 10
+
+    def test_rejects_unknown(self):
+        with pytest.raises(ValueError, match="unknown --chaos key"):
+            ChaosConfig.parse("bogus=1")
+        with pytest.raises(ValueError, match="nan|inf"):
+            ChaosConfig.parse("jitter")
+
+
+class TestGuardMeter:
+    def test_delta_based_rate_survives_sparse_sampling(self):
+        """The skip rate comes from cumulative-counter deltas, so observing
+        only every 10th step still reads the true rate (per-step sampling
+        would alias a periodic fault to 0% or 100%)."""
+        from tpu_compressed_dp.utils.meters import GuardMeter
+
+        gm = GuardMeter()
+        assert gm.summary() == {}  # guard off
+        # 10% true skip rate, observed at steps 10 and 20 only
+        gm.update({"guard/skipped": 1.0, "guard/loss_scale": 64.0}, step=10)
+        gm.update({"guard/skipped": 2.0, "guard/loss_scale": 64.0}, step=20)
+        s = gm.summary()
+        assert s["guard/skip_rate"] == pytest.approx(0.1)
+        assert s["guard/skipped"] == 2.0
+        gm.update({"loss": 1.0}, step=30)  # no guard metrics: ignored
+        assert gm.summary()["guard/skipped"] == 2.0
+
+
+# -------------------------------------------------- jitted-step integration
+
+def _build(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9,
+           dtype=jnp.float32):
+    import flax.linen as nn
+
+    from tpu_compressed_dp.models.common import init_model, make_apply_fn
+
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1)).astype(dtype)
+            x = nn.relu(nn.Dense(16, dtype=dtype)(x))
+            return nn.Dense(4, dtype=dtype)(x)
+
+    module = TinyMLP()
+    params, stats = init_model(module, jax.random.key(0),
+                               jnp.zeros((1, 4, 4, 3), jnp.float32))
+    opt = SGD(lr=0.05, momentum=momentum, nesterov=momentum > 0)
+    n = mesh.shape["data"]
+    state = TrainState.create(
+        params, stats, opt.init(params), init_ef_state(params, comp_cfg, n),
+        jax.random.key(1), comp=init_comp_state(params, comp_cfg, n),
+        guard=init_guard_state(guard_cfg))
+    step = make_train_step(make_apply_fn(module), opt, comp_cfg, mesh,
+                           guard_cfg=guard_cfg, chaos=chaos, donate=False)
+    return state, step
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"input": jnp.asarray(rng.randn(n, 4, 4, 3).astype(np.float32)),
+            "target": jnp.asarray(rng.randint(0, 4, n).astype(np.int32))}
+
+
+def test_single_worker_nan_vetoes_globally_and_holds_state(mesh8):
+    """The acceptance core: NaN on ONE worker at step k => the identical
+    skip decision everywhere, with ef (and params/opt/bn) bitwise held."""
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True)
+    gcfg = GuardConfig(loss_scaling=False)
+    chaos = ChaosConfig(kind="nan", target="grads", steps=(1,), worker=5)
+    state, step = _build(mesh8, comp, gcfg, chaos)
+    batch = _batch()
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 0.0
+    pre = jax.tree.map(np.asarray, (state.params, state.opt_state,
+                                    state.batch_stats, state.ef))
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 1.0
+    assert float(m["guard/skipped"]) == 1.0
+    assert float(m["guard/last_good_step"]) == 1.0
+    post = jax.tree.map(np.asarray, (state.params, state.opt_state,
+                                     state.batch_stats, state.ef))
+    for a, b in zip(jax.tree.leaves(pre), jax.tree.leaves(post)):
+        assert np.array_equal(a, b)
+    # the run recovers: next step applies
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 0.0
+    assert float(m["guard/skip_streak"]) == 0.0
+    assert int(state.step) == 3
+
+
+def test_guard_off_matches_guard_on_fp32(mesh8):
+    """With no faults and the fp32 identity scale, the guarded step computes
+    the same update as the unguarded one.  Not asserted bitwise: the guarded
+    program compiles separately and XLA may lower its psum with a different
+    reduction tree (fp add is non-associative — observed 1-ulp diffs on the
+    CPU backend), so the bound here is a tight ulp-scale tolerance; the
+    guard's *within-program* holds ARE bitwise (tested above)."""
+    comp = CompressionConfig(method="topk", ratio=0.5, error_feedback=True)
+    chaos = None
+    s0, step0 = _build(mesh8, comp, None, chaos)
+    gcfg = GuardConfig(loss_scaling=False)
+    s1, step1 = _build(mesh8, comp, gcfg, chaos)
+    batch = _batch()
+    for _ in range(3):
+        s0, _ = step0(s0, batch)
+        s1, _ = step1(s1, batch)
+    for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow  # two extra whole-step compiles; property also implied by
+                   # test_guard_off_matches_guard_on_fp32 + the bf16 dynamics
+def test_pow2_loss_scale_is_exact_on_fp32(mesh8):
+    """A power-of-two scale multiplies out exactly in fp32: scaled-loss
+    backprop + unscale == the unscaled gradient path, bitwise."""
+    comp = CompressionConfig(method=None)
+    s0, step0 = _build(mesh8, comp, GuardConfig(loss_scaling=False),
+                       None, momentum=0.0)
+    s1, step1 = _build(mesh8, comp,
+                       GuardConfig(init_scale=2.0 ** 12, growth_interval=10 ** 6,
+                                   loss_scaling=True),
+                       None, momentum=0.0)
+    batch = _batch()
+    for _ in range(2):
+        s0, _ = step0(s0, batch)
+        s1, _ = step1(s1, batch)
+    for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loss_scale_backoff_and_regrowth_bf16(mesh8):
+    """bf16 compute path: the dynamic scale halves on the injected overflow
+    and regrows after growth_interval good steps."""
+    comp = CompressionConfig(method=None)
+    gcfg = GuardConfig.for_dtype(jnp.bfloat16, init_scale=256.0,
+                                 growth_interval=2)
+    assert gcfg.loss_scaling
+    chaos = ChaosConfig(kind="inf", target="grads", steps=(0,), worker=3)
+    state, step = _build(mesh8, comp, gcfg, chaos, momentum=0.0,
+                         dtype=jnp.bfloat16)
+    batch = _batch()
+    scales = []
+    for _ in range(4):
+        state, m = step(state, batch)
+        scales.append(float(m["guard/loss_scale"]))
+    assert scales == [128.0, 128.0, 256.0, 256.0], scales
+    assert float(m["guard/skipped"]) == 1.0
+
+
+def test_guard_requires_state(mesh8):
+    comp = CompressionConfig(method=None)
+    gcfg = GuardConfig()
+    state, step = _build(mesh8, comp, gcfg, None)
+    state = dataclasses.replace(state, guard=())
+    with pytest.raises(ValueError, match="state.guard is empty"):
+        step(state, _batch())
+
+
+def test_wire_mode_guard_holds_ef(mesh8):
+    """The wire engine path (packed sparse payloads) is guarded too: EF held
+    bitwise on the vetoed step, finite throughout."""
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True,
+                             mode="wire", granularity="entiremodel")
+    gcfg = GuardConfig(loss_scaling=False)
+    chaos = ChaosConfig(kind="nan", target="grads", steps=(1,), worker=0)
+    state, step = _build(mesh8, comp, gcfg, chaos)
+    batch = _batch()
+    state, _ = step(state, batch)
+    pre_ef = jax.tree.map(np.asarray, state.ef)
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 1.0
+    for a, b in zip(jax.tree.leaves(pre_ef), jax.tree.leaves(state.ef)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------ lm-step path
+
+@pytest.mark.slow  # (2,2,2)-mesh LM compile; the vote/hold mechanics are
+                   # tier-1-covered on the DP step + quick drill
+def test_lm_step_guard_votes_across_full_mesh(mesh8):
+    """(data, seq, tensor) mesh: one poisoned (data, seq) worker's NaN must
+    veto the update on every tensor shard too (params held bitwise)."""
+    from tpu_compressed_dp.models import transformer as tf
+    from tpu_compressed_dp.train.lm_step import (init_lm_ef_state,
+                                                 make_lm_mesh,
+                                                 make_lm_train_step)
+
+    cfg = dataclasses.replace(tf.tiny_llama(vocab=64, dim=32, layers=1),
+                              n_heads=2, n_kv_heads=2, ffn_hidden=64)
+    mesh = make_lm_mesh(2, 2, 2)
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True,
+                             granularity="entiremodel")
+    gcfg = GuardConfig.for_dtype(cfg.dtype, init_scale=256.0)
+    chaos = ChaosConfig(kind="nan", target="grads", steps=(0,), worker=2)
+    params = tf.init_llama(cfg, jax.random.key(0))
+    opt = SGD(lr=1e-2, momentum=0.9)
+    state = TrainState.create(
+        params, {}, opt.init(params),
+        init_lm_ef_state(cfg, params, comp, mesh), jax.random.key(1),
+        guard=init_guard_state(gcfg))
+    step = make_lm_train_step(cfg, opt, comp, mesh, guard_cfg=gcfg,
+                              chaos=chaos, donate=False)
+    rng = np.random.RandomState(0)
+    batch = {"input": jnp.asarray(rng.randint(0, 64, (4, 32)).astype(np.int32)),
+             "target": jnp.asarray(rng.randint(0, 64, (4, 32)).astype(np.int32))}
+    pre = jax.tree.map(np.asarray, (state.params, state.ef))
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 1.0
+    assert float(m["guard/loss_scale"]) == 128.0  # bf16 path backed off
+    post = jax.tree.map(np.asarray, (state.params, state.ef))
+    for a, b in zip(jax.tree.leaves(pre), jax.tree.leaves(post)):
+        assert np.array_equal(a, b)
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 0.0
+    assert np.isfinite(float(m["loss"]))
+
+
+# ------------------------------------------------------ checkpoint plumbing
+
+class TestGuardCheckpoint:
+    def _state(self, guard):
+        params = {"w": jnp.arange(64, dtype=jnp.float32)}
+        return TrainState.create(params, {}, {"momentum": params}, (),
+                                 jax.random.key(1), guard=guard)
+
+    def test_guard_roundtrips_bitwise(self, tmp_path):
+        from tpu_compressed_dp.utils.checkpoint import (restore_checkpoint,
+                                                        save_checkpoint)
+
+        gs = GuardState(loss_scale=jnp.asarray(384.0),
+                        good_steps=jnp.asarray(7, jnp.int32),
+                        skips=jnp.asarray(2, jnp.int32),
+                        total_skipped=jnp.asarray(5, jnp.int32),
+                        last_good_step=jnp.asarray(123, jnp.int32))
+        save_checkpoint(str(tmp_path / "ck"), self._state(gs))
+        target = self._state(init_guard_state(GuardConfig()))
+        restored, _ = restore_checkpoint(str(tmp_path / "ck"), target)
+        for f in ("loss_scale", "good_steps", "skips", "total_skipped",
+                  "last_good_step"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(restored.guard, f)),
+                np.asarray(getattr(gs, f)))
+
+    def test_guard_off_roundtrips_as_empty(self, tmp_path):
+        from tpu_compressed_dp.utils.checkpoint import (restore_checkpoint,
+                                                        save_checkpoint)
+
+        save_checkpoint(str(tmp_path / "ck"), self._state(()))
+        restored, _ = restore_checkpoint(str(tmp_path / "ck"),
+                                         self._state(()))
+        assert restored.guard == ()
+
+    def test_guard_armed_after_guardless_save(self, tmp_path):
+        """Toggle regression (review finding): a checkpoint saved with the
+        guard OFF (on-disk marker ``guard: {}``) must restore into a
+        guard-armed target, keeping the target's fresh GuardState — Orbax
+        raises KeyError (not ValueError) for this marker-vs-template
+        mismatch, which the original fallback missed."""
+        from tpu_compressed_dp.utils.checkpoint import (restore_checkpoint,
+                                                        save_checkpoint)
+
+        save_checkpoint(str(tmp_path / "ck"), self._state(()))
+        fresh = init_guard_state(GuardConfig(init_scale=128.0))
+        restored, _ = restore_checkpoint(str(tmp_path / "ck"),
+                                         self._state(fresh))
+        assert float(restored.guard.loss_scale) == 128.0
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                      np.arange(64, dtype=np.float32))
+
+    def test_guard_disarmed_after_guarded_save(self, tmp_path):
+        """Reverse toggle: a guard-on checkpoint restores into a guard-off
+        target — the saved GuardState wins (harmless to an unguarded step,
+        preserved for a later re-arm)."""
+        from tpu_compressed_dp.utils.checkpoint import (restore_checkpoint,
+                                                        save_checkpoint)
+
+        gs = init_guard_state(GuardConfig(init_scale=512.0))
+        save_checkpoint(str(tmp_path / "ck"), self._state(gs))
+        restored, _ = restore_checkpoint(str(tmp_path / "ck"),
+                                         self._state(()))
+        assert float(restored.guard.loss_scale) == 512.0
+
+    def test_genuine_mismatch_still_raises(self, tmp_path):
+        """The template-free fallback must NOT mask real structure drift:
+        resized params raise instead of restoring garbage."""
+        from tpu_compressed_dp.utils.checkpoint import (restore_checkpoint,
+                                                        save_checkpoint)
+
+        save_checkpoint(str(tmp_path / "ck"), self._state(()))
+        bad_params = {"w": jnp.zeros((65,), jnp.float32)}  # 64 -> 65
+        target = TrainState.create(bad_params, {}, {"momentum": bad_params},
+                                   (), jax.random.key(0),
+                                   guard=init_guard_state(GuardConfig()))
+        with pytest.raises((ValueError, KeyError)):
+            restore_checkpoint(str(tmp_path / "ck"), target)
+
+    def test_pre_guard_checkpoint_keeps_callers_guard(self, tmp_path,
+                                                      monkeypatch):
+        """Legacy fallback (mirrors the `comp` fallback): a checkpoint
+        written before TrainState grew `guard` restores into a guard-armed
+        target, keeping the target's fresh GuardState."""
+        from tpu_compressed_dp.utils import checkpoint as ck
+
+        orig = ck._to_saveable
+
+        def legacy(s):
+            d = orig(s)
+            d.pop("guard")  # what a pre-guard writer produced
+            return d
+
+        monkeypatch.setattr(ck, "_to_saveable", legacy)
+        ck.save_checkpoint(str(tmp_path / "ck"), self._state(()))
+        monkeypatch.setattr(ck, "_to_saveable", orig)
+        fresh = init_guard_state(GuardConfig(init_scale=64.0))
+        restored, _ = ck.restore_checkpoint(str(tmp_path / "ck"),
+                                            self._state(fresh))
+        assert float(restored.guard.loss_scale) == 64.0
+        # guard-off target restores too
+        restored2, _ = ck.restore_checkpoint(str(tmp_path / "ck"),
+                                             self._state(()))
+        assert restored2.guard == ()
+
+    def test_pre_comp_and_pre_guard_checkpoint(self, tmp_path, monkeypatch):
+        """The double-legacy case: a pre-PowerSGD checkpoint (no comp AND no
+        guard on disk) restores into a target that has both."""
+        from tpu_compressed_dp.utils import checkpoint as ck
+
+        orig = ck._to_saveable
+
+        def ancient(s):
+            d = orig(s)
+            d.pop("guard")
+            d.pop("comp")
+            return d
+
+        monkeypatch.setattr(ck, "_to_saveable", ancient)
+        ck.save_checkpoint(str(tmp_path / "ck"), self._state(()))
+        monkeypatch.setattr(ck, "_to_saveable", orig)
+        params = {"w": jnp.arange(64, dtype=jnp.float32)}
+        target = TrainState.create(
+            params, {}, {"momentum": params}, (), jax.random.key(0),
+            comp=(), guard=init_guard_state(GuardConfig(init_scale=32.0)))
+        restored, _ = ck.restore_checkpoint(str(tmp_path / "ck"), target)
+        assert float(restored.guard.loss_scale) == 32.0
+        assert restored.comp == ()
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                      np.arange(64, dtype=np.float32))

@@ -26,6 +26,30 @@ class TestHeartbeat:
         assert resilience.is_stale(p, max_age_s=0.0)
         assert resilience.is_stale(str(tmp_path / "missing.json"), 1.0)
 
+    def test_concurrent_update_hammer(self, tmp_path):
+        """Regression (ISSUE 3 satellite): update() mutating the payload
+        while the writer thread serialises it raised 'dict changed size
+        during iteration' and silently killed the writer.  Hammer the
+        payload with growing/shrinking key sets against a hot writer and
+        assert the writer survives with valid JSON and no recorded error."""
+        p = str(tmp_path / "hb.json")
+        hb = resilience.Heartbeat(p, interval_s=0.0005, payload={"rank": 0})
+        deadline = time.time() + 0.6
+        i = 0
+        while time.time() < deadline:
+            i += 1
+            # churn the key SET (not just values): iteration-order breakage
+            # needs insertions/deletions mid-dump
+            payload = {f"k{j}_{i % 7}": float(j) for j in range(40)}
+            hb.update(step=i, **payload)
+            if i % 200 == 0:
+                time.sleep(0.001)  # let the writer thread in
+        assert hb._thread.is_alive(), "writer thread died mid-run"
+        assert hb.last_error is None, hb.last_error
+        hb.stop()
+        rec = resilience.read_heartbeat(p)
+        assert rec is not None and rec["step"] == i
+
 
 class TestRecovery:
     def _tiny_state(self):
@@ -85,3 +109,83 @@ class TestRecovery:
 
         with pytest.raises(RuntimeError, match="boom"):
             resilience.run_with_recovery(fails, self._tiny_state(), epochs=1)
+
+    def test_failure_before_first_checkpoint_raises_original(self, tmp_path):
+        """Satellite fix: a crash before ANY checkpoint exists used to
+        surface as the restore's FileNotFoundError, masking the actual
+        training failure."""
+        from tpu_compressed_dp.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(str(tmp_path / "ck"))  # empty directory
+
+        def fails(state, epoch):
+            raise RuntimeError("the real training failure")
+
+        with pytest.raises(RuntimeError, match="the real training failure"):
+            resilience.run_with_recovery(
+                fails, self._tiny_state(), epochs=2, checkpointer=ckpt,
+                max_retries=3)
+        ckpt.close()
+
+    def test_replay_epoch_when_meta_lacks_epoch(self, tmp_path):
+        """Satellite coverage: checkpoint meta without 'epoch' falls back to
+        replaying the FAILED epoch (epoch = (epoch-1) + 1), not skipping
+        ahead or rewinding to zero."""
+        from tpu_compressed_dp.utils.checkpoint import Checkpointer
+        import dataclasses
+
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        state = self._tiny_state()
+        calls = []
+        crashes = {"left": 1}
+
+        def epoch_fn(state, epoch):
+            calls.append(epoch)
+            if epoch == 2 and crashes["left"] > 0:
+                crashes["left"] -= 1
+                raise RuntimeError("transient")
+            state = dataclasses.replace(state, step=state.step + 1)
+            ckpt.save(state, {})  # meta has NO 'epoch' key
+            return state
+
+        final, info = resilience.run_with_recovery(
+            epoch_fn, state, epochs=4, checkpointer=ckpt, max_retries=2)
+        ckpt.close()
+        assert calls == [0, 1, 2, 2, 3]
+        assert info["restores"] == 1
+        assert int(final.step) == 4
+
+    def test_retry_budget_resets_only_on_progress(self, tmp_path):
+        """Satellite coverage: max_retries bounds CONSECUTIVE failures; a
+        completed epoch resets the budget, so 4 total failures spread as
+        2+2 survive max_retries=2 while 3 consecutive do not."""
+        from tpu_compressed_dp.utils.checkpoint import Checkpointer
+        import dataclasses
+
+        def run(fail_plan, epochs, max_retries, subdir):
+            ckpt = Checkpointer(str(tmp_path / subdir))
+            state = self._tiny_state()
+            remaining = dict(fail_plan)
+
+            def epoch_fn(state, epoch):
+                if remaining.get(epoch, 0) > 0:
+                    remaining[epoch] -= 1
+                    raise RuntimeError(f"flaky at {epoch}")
+                state = dataclasses.replace(state, step=state.step + 1)
+                ckpt.save(state, {"epoch": epoch})
+                return state
+
+            try:
+                return resilience.run_with_recovery(
+                    epoch_fn, state, epochs=epochs, checkpointer=ckpt,
+                    max_retries=max_retries)
+            finally:
+                ckpt.close()
+
+        # 2 failures at epoch 1, then 2 at epoch 3: never >2 consecutive
+        final, info = run({1: 2, 3: 2}, epochs=5, max_retries=2, subdir="a")
+        assert info["restores"] == 4
+        assert int(final.step) == 5
+        # 3 consecutive failures at epoch 1 exhaust max_retries=2
+        with pytest.raises(RuntimeError, match="flaky at 1"):
+            run({1: 3}, epochs=3, max_retries=2, subdir="b")

@@ -1,0 +1,382 @@
+#!/usr/bin/env python
+"""Chaos drill: run the fault-injection matrix and assert the step guard's
+invariants end to end.
+
+What it proves (the ISSUE 3 acceptance criteria, each as a named drill):
+
+  * ``skip_consistency`` — NaN injected into ONE worker's gradients at step k
+    => the cross-worker vote vetoes the update everywhere: params, optimizer
+    buffers, batch stats and EF residual are bitwise equal to their pre-step
+    values, and every other step applies normally.
+  * ``comp_hold`` — same, for the stateful compressor path (PowerSGD): the
+    warm-start Q factors are held bitwise on the skipped step.
+  * ``loss_scale`` — an Inf backs the dynamic loss scale off by
+    ``backoff``; ``growth_interval`` consecutive good steps regrow it.
+  * ``ef_identity`` — on non-skipped steps the EF identity holds through the
+    guarded sync: world-mean(transmitted) + local residual change accounts
+    for the full gradient, i.e. ``psum(acc - new_ef)/W == synced`` per
+    worker (checked for the simulate and wire+sharded transports).
+  * ``poison_control`` — the control arm: the SAME injection with the guard
+    OFF poisons the parameters (proves the injection actually fires and the
+    guard is what contains it).
+  * ``max_skips`` — an every-step injection wedges the run; the host-side
+    check raises GuardExceeded once the consecutive-skip streak passes
+    ``max_consecutive_skips``.
+  * ``crash_recovery`` — a host-crash injection mid-run recovers through
+    ``run_with_recovery`` (Orbax restore + replay) to a final state bitwise
+    identical to the uncrashed run — chaos is step-counter driven, so the
+    replay reproduces the same faults.
+
+Usage::
+
+    python tools/chaos_drill.py --quick     # tier-1 smoke subset (~4 drills)
+    python tools/chaos_drill.py             # full matrix (slow)
+
+Exit code 0 = every invariant held.
+"""
+
+from __future__ import annotations
+
+import os
+
+# standalone invocation: an 8-device virtual CPU mesh, set up before the
+# first jax import (harmless no-op when imported from the test suite, whose
+# conftest already did this)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+        + " --xla_backend_optimization_level=0").strip()
+
+import argparse
+import dataclasses
+import tempfile
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------- fixtures
+
+def _mesh(n=8):
+    from tpu_compressed_dp.parallel.mesh import make_data_mesh
+
+    return make_data_mesh(n)
+
+
+def _tiny_setup(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9, seed=0):
+    """TinyMLP + optimizer + state + guarded train step on ``mesh``."""
+    import flax.linen as nn
+
+    from tpu_compressed_dp.models.common import init_model, make_apply_fn
+    from tpu_compressed_dp.parallel.dp import init_comp_state, init_ef_state
+    from tpu_compressed_dp.train.guard import init_guard_state
+    from tpu_compressed_dp.train.optim import SGD
+    from tpu_compressed_dp.train.state import TrainState
+    from tpu_compressed_dp.train.step import make_train_step
+
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(4)(x)
+
+    module = TinyMLP()
+    params, stats = init_model(module, jax.random.key(seed),
+                               jnp.zeros((1, 4, 4, 3), jnp.float32))
+    opt = SGD(lr=0.05, momentum=momentum, nesterov=momentum > 0)
+    ndev = mesh.shape["data"]
+    state = TrainState.create(
+        params, stats, opt.init(params),
+        init_ef_state(params, comp_cfg, ndev), jax.random.key(seed + 1),
+        comp=init_comp_state(params, comp_cfg, ndev),
+        guard=init_guard_state(guard_cfg),
+    )
+    step = make_train_step(make_apply_fn(module), opt, comp_cfg, mesh,
+                           guard_cfg=guard_cfg, chaos=chaos, donate=False)
+    return state, step
+
+
+def _batch(seed=0, n=32):
+    rng = np.random.RandomState(seed)
+    return {
+        "input": jnp.asarray(rng.randn(n, 4, 4, 3).astype(np.float32)),
+        "target": jnp.asarray(rng.randint(0, 4, n).astype(np.int32)),
+    }
+
+
+def _snap(state, fields=("params", "opt_state", "batch_stats", "ef", "comp")):
+    return {f: jax.tree.map(np.asarray, getattr(state, f)) for f in fields}
+
+
+def _assert_bitwise(a, b, what):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+            f"{what}: leaf not bitwise equal")
+
+
+# ------------------------------------------------------------------ drills
+
+def drill_skip_consistency(mesh, *, kind="nan", target="grads", worker=2,
+                           bad_step=2, n_steps=5) -> Dict:
+    """One poisoned worker at one step => identical global skip; everything
+    the step mutates held bitwise; all other steps applied."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.guard import GuardConfig
+    from tpu_compressed_dp.utils.chaos import ChaosConfig
+
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True)
+    gcfg = GuardConfig(loss_scaling=False, max_consecutive_skips=10)
+    chaos = ChaosConfig(kind=kind, target=target, steps=(bad_step,),
+                        worker=worker)
+    state, step = _tiny_setup(mesh, comp, gcfg, chaos)
+    batch = _batch()
+    nonfinite = []
+    for i in range(n_steps):
+        pre = _snap(state) if i == bad_step else None
+        state, m = step(state, batch)
+        nonfinite.append(float(m["guard/nonfinite"]))
+        if i == bad_step:
+            _assert_bitwise(pre, _snap(state),
+                            f"skip_consistency[{kind}/{target}] held state")
+            assert float(m["guard/skip_streak"]) == 1.0
+            assert float(m["guard/last_good_step"]) == bad_step
+        assert np.isfinite(float(m["loss"]))
+    expected = [1.0 if i == bad_step else 0.0 for i in range(n_steps)]
+    assert nonfinite == expected, (nonfinite, expected)
+    assert int(state.step) == n_steps
+    for leaf in jax.tree.leaves(state.ef):
+        assert np.all(np.isfinite(np.asarray(leaf))), "EF picked up poison"
+    return {"nonfinite": nonfinite}
+
+
+def drill_comp_hold(mesh) -> Dict:
+    """PowerSGD warm-start Q (TrainState.comp) held bitwise on the vetoed
+    step, mutated on good steps."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.guard import GuardConfig
+    from tpu_compressed_dp.utils.chaos import ChaosConfig
+
+    comp = CompressionConfig(method="powersgd", rank=2, error_feedback=True)
+    gcfg = GuardConfig(loss_scaling=False)
+    chaos = ChaosConfig(kind="inf", target="grads", steps=(1,), worker=0)
+    state, step = _tiny_setup(mesh, comp, gcfg, chaos)
+    batch = _batch()
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 0.0
+    pre = _snap(state, ("comp", "ef"))
+    good_comp = {k: np.asarray(v) for k, v in state.comp.items()}
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 1.0
+    _assert_bitwise(pre, _snap(state, ("comp", "ef")), "comp_hold")
+    state, m = step(state, batch)
+    assert float(m["guard/nonfinite"]) == 0.0
+    moved = any(not np.array_equal(np.asarray(state.comp[k]), good_comp[k])
+                for k in good_comp)
+    assert moved, "comp never updates on good steps?"
+    return {}
+
+
+def drill_loss_scale(mesh) -> Dict:
+    """Backoff on the bad step, regrowth after growth_interval good steps."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.guard import GuardConfig
+    from tpu_compressed_dp.utils.chaos import ChaosConfig
+
+    comp = CompressionConfig(method=None)
+    gcfg = GuardConfig(init_scale=1024.0, backoff=0.5, growth=2.0,
+                       growth_interval=3, loss_scaling=True)
+    chaos = ChaosConfig(kind="inf", target="loss", steps=(1,), worker=0)
+    state, step = _tiny_setup(mesh, comp, gcfg, chaos, momentum=0.0)
+    batch = _batch()
+    scales = []
+    for _ in range(6):
+        state, m = step(state, batch)
+        scales.append(float(m["guard/loss_scale"]))
+    assert scales == [1024.0, 512.0, 512.0, 512.0, 1024.0, 1024.0], scales
+    return {"scales": scales}
+
+
+def drill_ef_identity(mesh, transport="allgather", mode="simulate") -> Dict:
+    """transmitted + residual == gradient on a non-vetoed guarded sync:
+    per worker, ``psum(acc - new_ef)/W == synced`` where acc = grad + ef."""
+    from tpu_compressed_dp.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grad_sync
+
+    cfg = CompressionConfig(method="topk", ratio=0.25, error_feedback=True,
+                            mode=mode, transport=transport,
+                            granularity="entiremodel")
+    sync = make_grad_sync(cfg, "data")
+    n = 512
+    W = mesh.shape["data"]
+    rng = np.random.RandomState(3)
+    grads = jnp.asarray(rng.randn(W, n).astype(np.float32))
+    efs = jnp.asarray(0.1 * rng.randn(W, n).astype(np.float32))
+
+    def local(g, e):
+        ok = jnp.asarray(True)
+        synced, new_ef, _, _ = sync({"w": g[0]}, {"w": e[0]}, (),
+                                    jax.random.key(0), ok=ok)
+        sent = g[0] + e[0] - new_ef["w"]  # what this worker transmitted
+        mean_sent = jax.lax.psum(sent, "data") / jax.lax.psum(1, "data")
+        gap = jnp.max(jnp.abs(mean_sent - synced["w"]))
+        return gap[None], new_ef["w"][None]
+
+    gap, new_ef = jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=(P("data"), P("data"))))(grads, efs)
+    assert float(jnp.max(gap)) < 1e-5, float(jnp.max(gap))
+    return {"max_gap": float(jnp.max(gap))}
+
+
+def drill_poison_control(mesh) -> Dict:
+    """Control arm: guard OFF, same injection => params DO go nonfinite
+    (the injection is real; the guard is what contains it)."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.utils.chaos import ChaosConfig
+
+    comp = CompressionConfig(method=None)
+    chaos = ChaosConfig(kind="nan", target="grads", steps=(1,), worker=4)
+    state, step = _tiny_setup(mesh, comp, None, chaos, momentum=0.0)
+    batch = _batch()
+    for _ in range(2):
+        state, m = step(state, batch)
+    finite = all(np.all(np.isfinite(np.asarray(l)))
+                 for l in jax.tree.leaves(state.params))
+    assert not finite, "chaos injection did not fire"
+    return {}
+
+
+def drill_max_skips(mesh) -> Dict:
+    """Every-step injection wedges the run; the host check raises."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.guard import (GuardConfig, GuardExceeded,
+                                               check_guard_metrics)
+    from tpu_compressed_dp.utils.chaos import ChaosConfig
+
+    comp = CompressionConfig(method=None)
+    gcfg = GuardConfig(loss_scaling=False, max_consecutive_skips=3)
+    chaos = ChaosConfig(kind="nan", target="grads", every=1, worker=0)
+    state, step = _tiny_setup(mesh, comp, gcfg, chaos, momentum=0.0)
+    batch = _batch()
+    raised_at = None
+    try:
+        for i in range(8):
+            state, m = step(state, batch)
+            check_guard_metrics(jax.device_get(m), gcfg)
+    except GuardExceeded:
+        raised_at = i
+    assert raised_at == 3, f"GuardExceeded at step {raised_at}, expected 3"
+    return {"raised_at_step": raised_at}
+
+
+def drill_crash_recovery(mesh, *, crash_at_step=5, chaos_spec=None) -> Dict:
+    """Host-crash at step N + run_with_recovery == the uncrashed run,
+    bitwise — including when in-graph chaos fires around the crash (the
+    step-counter-driven injection replays identically after restore)."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.guard import GuardConfig
+    from tpu_compressed_dp.utils import resilience
+    from tpu_compressed_dp.utils.chaos import ChaosConfig, CrashInjector
+    from tpu_compressed_dp.utils.checkpoint import Checkpointer
+
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True)
+    gcfg = GuardConfig(loss_scaling=False)
+    chaos = (ChaosConfig.parse(chaos_spec) if chaos_spec
+             else ChaosConfig(kind="nan", target="grads", steps=(3,), worker=1))
+    epochs, steps_per_epoch = 4, 2
+    batches = [_batch(seed=s) for s in range(steps_per_epoch)]
+
+    def run(crash: Optional[CrashInjector], ckpt_dir: Optional[str]):
+        state, step = _tiny_setup(mesh, comp, gcfg, chaos)
+        ckpt = Checkpointer(ckpt_dir) if ckpt_dir else None
+
+        def epoch_fn(state, epoch):
+            for i, b in enumerate(batches):
+                if crash is not None:
+                    crash.check(epoch * steps_per_epoch + i)
+                state, _ = step(state, b)
+            if ckpt:
+                ckpt.save(state, {"epoch": epoch})
+            return state
+
+        if ckpt:
+            final, info = resilience.run_with_recovery(
+                epoch_fn, state, epochs, checkpointer=ckpt,
+                on_restore=lambda s: s.with_mesh_sharding(mesh))
+            ckpt.close()
+        else:
+            info = {"restores": 0}
+            final = state
+            for e in range(epochs):
+                final = epoch_fn(final, e)
+        return final, info
+
+    clean, _ = run(None, None)
+    with tempfile.TemporaryDirectory() as td:
+        crashed, info = run(CrashInjector(crash_at_step),
+                            os.path.join(td, "ck"))
+    assert info["restores"] == 1, info
+    _assert_bitwise(_snap(clean), _snap(crashed), "crash_recovery state")
+    assert int(clean.step) == int(crashed.step) == epochs * steps_per_epoch
+    for f in ("loss_scale", "skips", "total_skipped", "last_good_step"):
+        assert np.array_equal(np.asarray(getattr(clean.guard, f)),
+                              np.asarray(getattr(crashed.guard, f))), f
+    return {"restores": info["restores"]}
+
+
+# -------------------------------------------------------------------- main
+
+QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery"]
+FULL = QUICK + ["comp_hold", "ef_identity", "poison_control",
+                "skip_matrix", "ef_identity_sharded"]
+
+
+def run_drills(names, mesh=None) -> Dict[str, Dict]:
+    mesh = mesh or _mesh()
+    results = {}
+    for name in names:
+        if name == "skip_matrix":
+            # the full kind x target x worker cross
+            for kind in ("nan", "inf"):
+                for target in ("grads", "loss"):
+                    for worker in (0, 7):
+                        key = f"skip[{kind},{target},w{worker}]"
+                        results[key] = drill_skip_consistency(
+                            mesh, kind=kind, target=target, worker=worker)
+                        print(f"PASS {key}")
+            continue
+        if name == "ef_identity_sharded":
+            results[name] = drill_ef_identity(mesh, transport="sharded",
+                                              mode="wire")
+        else:
+            results[name] = globals()[f"drill_{name}"](mesh)
+        print(f"PASS {name}")
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--quick", action="store_true",
+                   help="tier-1 smoke subset (skip_consistency, loss_scale, "
+                        "max_skips, crash_recovery)")
+    p.add_argument("--drill", action="append", default=None,
+                   help="run only the named drill(s)")
+    args = p.parse_args(argv)
+    names = args.drill or (QUICK if args.quick else FULL)
+    run_drills(names)
+    print(f"chaos drill: {len(names)} drill group(s) passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

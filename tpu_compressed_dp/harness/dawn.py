@@ -30,7 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_compressed_dp.data import cifar10 as data
-from tpu_compressed_dp.harness.loop import train_epoch
+from tpu_compressed_dp.harness.loop import (add_robustness_args,
+                                            build_robustness, make_heartbeat,
+                                            train_epoch)
 from tpu_compressed_dp.models import alexnet as alexnet_mod
 from tpu_compressed_dp.models import resnet9 as resnet9_mod
 from tpu_compressed_dp.models import vgg as vgg_mod
@@ -43,6 +45,7 @@ from tpu_compressed_dp.parallel.dp import (CompressionConfig, init_comp_state,
                                            init_ef_state)
 from tpu_compressed_dp.parallel.mesh import distributed_init, make_data_mesh
 from tpu_compressed_dp.train.optim import SGD
+from tpu_compressed_dp.train.guard import init_guard_state
 from tpu_compressed_dp.train.schedules import piecewise_linear
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import make_eval_step, make_train_step
@@ -190,6 +193,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--channels_scale", type=float, default=1.0,
                    help="width multiplier for the graph-family nets")
     p.add_argument("--seed", type=int, default=0)
+    # robustness: shared --guard*/--chaos/--heartbeat surface
+    add_robustness_args(p, check_note="checked at epoch end")
     p.add_argument("--tensorboard", action="store_true",
                    help="write tensorboard scalars under <log_dir>/tb")
     p.add_argument("--profile_epoch", type=int, default=None,
@@ -355,10 +360,13 @@ def run(args) -> dict:
             epoch, ratio=args.ratio, warmup_epochs=args.ratio_warmup_epochs,
             method=comp.method)
 
+    guard_cfg, chaos, crash = build_robustness(args, jnp.dtype(args.dtype))
+
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp, ndev),
         jax.random.key(args.seed + 1),
         comp=init_comp_state(params, comp, ndev),
+        guard=init_guard_state(guard_cfg),
     )
     apply_fn = make_normalizing_apply_fn(
         module,
@@ -373,7 +381,8 @@ def run(args) -> dict:
             step_cache[ratio] = make_train_step(
                 apply_fn, opt, comp_for_ratio(ratio), mesh,
                 grad_scale=float(bs), clip_norm=args.clip_norm,
-                clip_sent_norm=args.clip_sent_norm)
+                clip_sent_norm=args.clip_sent_norm,
+                guard_cfg=guard_cfg, chaos=chaos)
         return step_cache[ratio]
 
     eval_step = make_eval_step(apply_fn, mesh)
@@ -394,34 +403,54 @@ def run(args) -> dict:
         os.path.join(args.log_dir, "tb")
         if args.log_dir and args.tensorboard and rank0 else None
     )
+    hb = make_heartbeat(args)
     summary = {}
-    for epoch in range(epochs):
-        profiling = args.profile_epoch == epoch and args.log_dir
-        if profiling:
-            jax.profiler.start_trace(os.path.join(args.log_dir, "profile"))
-        train_step = train_step_for(ratio_for_epoch(epoch))
-        state, epoch_stats = train_epoch(
-            train_step, eval_step, state, train_batches, test_batches, timer, bs,
-            test_time_in_total=False,
-        )
-        if profiling:
-            jax.profiler.stop_trace()
-        summary = {
-            "epoch": epoch + 1,
-            "lr": float(sched((epoch + 1))),
-            **{k: (float(v) if isinstance(v, (int, float, np.floating)) else v)
-               for k, v in epoch_stats.items()},
-        }
-        if rank0:
-            table.append(summary)
-            tsv.append(summary)
-            tb.update_examples_count(len(train_batches) * bs)
-            tb.log_metrics({f"losses/{k}": v for k, v in summary.items()
-                            if k in ("train loss", "test loss", "train acc", "test acc")})
-            tb.log_scalar("times/epoch_seconds", summary["train time"])
-    if args.log_dir and rank0:
-        tsv.save(args.log_dir)
-    tb.close()
+    # finally-guarded: GuardExceeded / ChaosCrash / any training failure must
+    # not leak the heartbeat writer thread — an orphaned writer keeps
+    # refreshing ts and turns a dead run into a stale-detection false
+    # negative (the exact failure mode the watchdog reads this file for)
+    try:
+        for epoch in range(epochs):
+            profiling = args.profile_epoch == epoch and args.log_dir
+            if profiling:
+                jax.profiler.start_trace(os.path.join(args.log_dir, "profile"))
+            train_step = train_step_for(ratio_for_epoch(epoch))
+            state, epoch_stats = train_epoch(
+                train_step, eval_step, state, train_batches, test_batches, timer, bs,
+                test_time_in_total=False,
+                crash=crash, step_offset=int(state.step), guard_cfg=guard_cfg,
+            )
+            if profiling:
+                jax.profiler.stop_trace()
+            if hb is not None:
+                # last_good_step: the watchdog's "is it making progress" signal
+                # — a wedged-but-alive run (skipping every step) beats but stops
+                # advancing this field
+                hb.update(
+                    step=int(state.step),
+                    last_good_step=(int(state.guard.last_good_step)
+                                    if guard_cfg is not None else int(state.step)),
+                    epoch=epoch,
+                )
+            summary = {
+                "epoch": epoch + 1,
+                "lr": float(sched((epoch + 1))),
+                **{k: (float(v) if isinstance(v, (int, float, np.floating)) else v)
+                   for k, v in epoch_stats.items()},
+            }
+            if rank0:
+                table.append(summary)
+                tsv.append(summary)
+                tb.update_examples_count(len(train_batches) * bs)
+                tb.log_metrics({f"losses/{k}": v for k, v in summary.items()
+                                if k in ("train loss", "test loss", "train acc", "test acc")})
+                tb.log_scalar("times/epoch_seconds", summary["train time"])
+        if args.log_dir and rank0:
+            tsv.save(args.log_dir)
+    finally:
+        tb.close()
+        if hb is not None:
+            hb.stop()
     return summary
 
 

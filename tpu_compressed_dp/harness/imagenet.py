@@ -43,7 +43,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_compressed_dp.data import imagenet as data
-from tpu_compressed_dp.harness.loop import comm_summary, pad_batch, run_eval, run_train_epoch
+from tpu_compressed_dp.harness.loop import (
+    add_robustness_args,
+    build_robustness,
+    make_heartbeat,
+    comm_summary,
+    guard_summary,
+    pad_batch,
+    run_eval,
+    run_train_epoch,
+)
 from tpu_compressed_dp.models import resnet as resnet_mod
 from tpu_compressed_dp.models.common import init_model, make_apply_fn
 from tpu_compressed_dp.parallel.dp import (CompressionConfig, init_comp_state,
@@ -54,6 +63,7 @@ from tpu_compressed_dp.parallel.mesh import (
     make_global_batch,
 )
 from tpu_compressed_dp.train.optim import SGD, bn_wd_mask
+from tpu_compressed_dp.train.guard import init_guard_state
 from tpu_compressed_dp.train.schedules import phase_lr_schedule_variable_bs
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import make_eval_step, make_train_step
@@ -260,6 +270,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="min top-5 before checkpointing (reference used 93)")
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--synthetic_n", type=int, default=512)
+    # robustness: shared --guard*/--chaos/--heartbeat surface
+    add_robustness_args(p, check_note="checked at epoch end")
     p.add_argument("--logdir", type=str, default=None)
     p.add_argument("--tensorboard", action="store_true",
                    help="write tensorboard scalars under <logdir>/tb")
@@ -343,10 +355,12 @@ def run(args) -> Dict[str, float]:
         rank=args.rank,
         error_feedback=args.error_feedback,
     )
+    guard_cfg, chaos, crash = build_robustness(args, dtype)
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp, ndev),
         jax.random.key((args.seed + 1) % (2**31)),
         comp=init_comp_state(params, comp, ndev),
+        guard=init_guard_state(guard_cfg),
     )
 
     ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
@@ -365,7 +379,8 @@ def run(args) -> Dict[str, float]:
 
     train_step = make_train_step(apply_fn, opt, comp, mesh, grad_scale=1.0,
                                  clip_norm=args.clip_norm,
-                                 clip_sent_norm=args.clip_sent_norm)
+                                 clip_sent_norm=args.clip_sent_norm,
+                                 guard_cfg=guard_cfg, chaos=chaos)
     eval_step = make_eval_step(apply_fn, mesh)
 
     def validate(state) -> Dict[str, float]:
@@ -392,87 +407,108 @@ def run(args) -> Dict[str, float]:
     flog = FileLogger(args.logdir if is_master else None, rank=jax.process_index(),
                       is_master=is_master)
     net_meter = NetworkMeter()
+    hb = make_heartbeat(args)
 
-    if args.evaluate:
-        # a finished run evaluates at its final phase's resolution
-        pd.set_epoch(min(start_epoch, epochs - 1))
-        stats_val = validate(state)
-        print(f"top1 {stats_val['acc']*100:.2f} top5 {stats_val['acc5']*100:.2f}")
+    # finally-guarded: GuardExceeded / ChaosCrash / any failure must not
+    # leak the heartbeat writer thread (an orphaned writer keeps the ts
+    # fresh and defeats staleness detection) or the checkpoint manager
+    try:
+        if args.evaluate:
+            # a finished run evaluates at its final phase's resolution
+            pd.set_epoch(min(start_epoch, epochs - 1))
+            stats_val = validate(state)
+            print(f"top1 {stats_val['acc']*100:.2f} top5 {stats_val['acc5']*100:.2f}")
+            return stats_val
+
+        for epoch in range(start_epoch, epochs):
+            swapped = pd.set_epoch(epoch)
+            if swapped and ckpt and epoch > 0:
+                # phase-boundary save (`train_imagenet_nv.py:251-253`)
+                ckpt.save(state, {"epoch": epoch - 1, "phase_boundary": True})
+
+            def train_batches():
+                for b in _truncate(pd.train_loader, 10 if args.short_epoch else None):
+                    yield make_global_batch(b, mesh)
+
+            profiling = args.profile_epoch == epoch and args.logdir
+            if profiling:
+                jax.profiler.start_trace(os.path.join(args.logdir, "profile"))
+            state, acc = run_train_epoch(train_step, state, train_batches(),
+                                         crash=crash, step_offset=int(state.step),
+                                         guard_cfg=guard_cfg)
+            if profiling:
+                jax.profiler.stop_trace()
+            if hb is not None:
+                hb.update(
+                    step=int(state.step),
+                    last_good_step=(int(state.guard.last_good_step)
+                                    if guard_cfg is not None else int(state.step)),
+                    epoch=epoch,
+                )
+            train_time = timer()
+            val_stats = validate(state)
+            timer()
+            top1, top5 = val_stats["acc"] * 100, val_stats["acc5"] * 100
+            hours = (time.time() - t0) / 3600
+            # `~~epoch\thours\ttop1\ttop5` event line (`train_imagenet_nv.py:232,243`)
+            flog.event(f"~~{epoch}\t{hours:.5f}\t\t{top1:.3f}\t\t{top5:.3f}\n")
+            summary = {
+                "epoch": epoch, "train time": train_time,
+                "train loss": acc.mean("loss"),
+                "test loss": val_stats["loss"], "top1": top1, "top5": top5,
+                "test acc": val_stats["acc"],  # TSVLogger's top1 column
+                "total time": timer.total_time,
+            }
+            summary.update(comm_summary(acc))
+            summary.update(guard_summary(acc))
+            table.append(summary)
+            tsv.append(summary)
+            # tensorboard: x-axis = cumulative examples (`logger.py:24-34`);
+            # namespaces mirror the reference (losses/ times/ net/)
+            examples = int(acc.sums.get("count", 0.0))
+            tb.update_examples_count(examples)
+            tb.log_scalar("losses/train_loss", acc.mean("loss"))
+            tb.log_scalar("losses/test_loss", val_stats["loss"])
+            tb.log_scalar("losses/top1", top1)
+            tb.log_scalar("losses/top5", top5)
+            tb.log_scalar("times/epoch_seconds", train_time)
+            if examples and train_time > 0:
+                tb.log_scalar("times/images_per_sec", examples / train_time)
+            if "comm/sent_bits" in acc.sums and train_time > 0:
+                # analytic per-chip link traffic at the epoch's measured rate,
+                # method-aware (VERDICT r2 #2, same arithmetic as bench/sweep.py):
+                # ring psum moves 2(W-1)/W x payload per chip, all_gather of
+                # worker-distinct payloads ~(W-1) x payload per chip
+                from tpu_compressed_dp.utils.meters import per_chip_traffic_bytes
+
+                payload_b = acc.mean("comm/sent_bits") / 8  # bytes per step
+                psum_b = acc.mean("comm/sent_bits_psum") / 8 if "comm/sent_bits_psum" in acc.sums else payload_b
+                ag_b = acc.mean("comm/sent_bits_allgather") / 8 if "comm/sent_bits_allgather" in acc.sums else 0.0
+                a2a_b = acc.mean("comm/sent_bits_alltoall") / 8 if "comm/sent_bits_alltoall" in acc.sums else 0.0
+                steps_done = examples / max(int(pd.cur["bs"]), 1)
+                per_chip_b = per_chip_traffic_bytes(psum_b, ag_b, ndev, a2a_b)
+                tb.log_scalar("net/payload_mb_per_step", payload_b / 1e6)
+                tb.log_scalar("net/allreduce_gbps_per_chip",
+                              per_chip_b * steps_done / 1e9 / train_time)
+            recv_g, sent_g = net_meter.update_bandwidth()
+            tb.log_scalar("net/recv_gbit_s", recv_g)
+            tb.log_scalar("net/transmit_gbit_s", sent_g)
+            if "guard/nonfinite" in acc.sums:
+                tb.log_scalar("guard/skip_rate", acc.mean("guard/nonfinite"))
+                tb.log_scalar("guard/loss_scale",
+                              acc.last.get("guard/loss_scale", 1.0))
+                tb.log_scalar("guard/skipped", acc.last.get("guard/skipped", 0.0))
+            if ckpt:
+                ckpt.save_if_best(state, top5, floor=args.best_floor,
+                                  meta={"epoch": epoch, "top1": top1, "top5": top5})
+        if args.logdir:
+            tsv.save(args.logdir)
+    finally:
+        tb.close()
+        if hb is not None:
+            hb.stop()
         if ckpt:
             ckpt.close()
-        return stats_val
-
-    for epoch in range(start_epoch, epochs):
-        swapped = pd.set_epoch(epoch)
-        if swapped and ckpt and epoch > 0:
-            # phase-boundary save (`train_imagenet_nv.py:251-253`)
-            ckpt.save(state, {"epoch": epoch - 1, "phase_boundary": True})
-
-        def train_batches():
-            for b in _truncate(pd.train_loader, 10 if args.short_epoch else None):
-                yield make_global_batch(b, mesh)
-
-        profiling = args.profile_epoch == epoch and args.logdir
-        if profiling:
-            jax.profiler.start_trace(os.path.join(args.logdir, "profile"))
-        state, acc = run_train_epoch(train_step, state, train_batches())
-        if profiling:
-            jax.profiler.stop_trace()
-        train_time = timer()
-        val_stats = validate(state)
-        timer()
-        top1, top5 = val_stats["acc"] * 100, val_stats["acc5"] * 100
-        hours = (time.time() - t0) / 3600
-        # `~~epoch\thours\ttop1\ttop5` event line (`train_imagenet_nv.py:232,243`)
-        flog.event(f"~~{epoch}\t{hours:.5f}\t\t{top1:.3f}\t\t{top5:.3f}\n")
-        summary = {
-            "epoch": epoch, "train time": train_time,
-            "train loss": acc.mean("loss"),
-            "test loss": val_stats["loss"], "top1": top1, "top5": top5,
-            "test acc": val_stats["acc"],  # TSVLogger's top1 column
-            "total time": timer.total_time,
-        }
-        summary.update(comm_summary(acc))
-        table.append(summary)
-        tsv.append(summary)
-        # tensorboard: x-axis = cumulative examples (`logger.py:24-34`);
-        # namespaces mirror the reference (losses/ times/ net/)
-        examples = int(acc.sums.get("count", 0.0))
-        tb.update_examples_count(examples)
-        tb.log_scalar("losses/train_loss", acc.mean("loss"))
-        tb.log_scalar("losses/test_loss", val_stats["loss"])
-        tb.log_scalar("losses/top1", top1)
-        tb.log_scalar("losses/top5", top5)
-        tb.log_scalar("times/epoch_seconds", train_time)
-        if examples and train_time > 0:
-            tb.log_scalar("times/images_per_sec", examples / train_time)
-        if "comm/sent_bits" in acc.sums and train_time > 0:
-            # analytic per-chip link traffic at the epoch's measured rate,
-            # method-aware (VERDICT r2 #2, same arithmetic as bench/sweep.py):
-            # ring psum moves 2(W-1)/W x payload per chip, all_gather of
-            # worker-distinct payloads ~(W-1) x payload per chip
-            from tpu_compressed_dp.utils.meters import per_chip_traffic_bytes
-
-            payload_b = acc.mean("comm/sent_bits") / 8  # bytes per step
-            psum_b = acc.mean("comm/sent_bits_psum") / 8 if "comm/sent_bits_psum" in acc.sums else payload_b
-            ag_b = acc.mean("comm/sent_bits_allgather") / 8 if "comm/sent_bits_allgather" in acc.sums else 0.0
-            a2a_b = acc.mean("comm/sent_bits_alltoall") / 8 if "comm/sent_bits_alltoall" in acc.sums else 0.0
-            steps_done = examples / max(int(pd.cur["bs"]), 1)
-            per_chip_b = per_chip_traffic_bytes(psum_b, ag_b, ndev, a2a_b)
-            tb.log_scalar("net/payload_mb_per_step", payload_b / 1e6)
-            tb.log_scalar("net/allreduce_gbps_per_chip",
-                          per_chip_b * steps_done / 1e9 / train_time)
-        recv_g, sent_g = net_meter.update_bandwidth()
-        tb.log_scalar("net/recv_gbit_s", recv_g)
-        tb.log_scalar("net/transmit_gbit_s", sent_g)
-        if ckpt:
-            ckpt.save_if_best(state, top5, floor=args.best_floor,
-                              meta={"epoch": epoch, "top1": top1, "top5": top5})
-    if args.logdir:
-        tsv.save(args.logdir)
-    tb.close()
-    if ckpt:
-        ckpt.close()
     return summary
 
 

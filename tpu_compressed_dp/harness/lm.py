@@ -106,6 +106,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
                         "via comm/shard_overflow)")
     p.add_argument("--error_feedback", action="store_true")
+    # robustness: shared --guard*/--chaos/--heartbeat surface
+    from tpu_compressed_dp.harness.loop import add_robustness_args
+
+    add_robustness_args(p, check_note="checked every --log_every")
     # plumbing
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log_every", type=int, default=10)
@@ -187,6 +191,10 @@ def run(args) -> Dict[str, float]:
         rank=args.rank,
         error_feedback=args.error_feedback,
     )
+    from tpu_compressed_dp.harness.loop import build_robustness
+    from tpu_compressed_dp.train.guard import init_guard_state
+
+    guard_cfg, chaos, crash = build_robustness(args, cfg.dtype)
     if pipelined:
         # NB make_pp_train_step rejects method='powersgd' (stacked-layer
         # params shard over pipe; no warm-start init exists for that layout)
@@ -199,11 +207,13 @@ def run(args) -> Dict[str, float]:
             params, {}, opt.init(params),
             init_pp_ef_state(cfg, params, comp, mesh),
             jax.random.key(args.seed + 1),
+            guard=init_guard_state(guard_cfg),
         )
         train_step = make_pp_train_step(cfg, opt, comp, mesh,
                                         microbatches=args.microbatches,
                                         clip_norm=args.clip_norm,
-                                        clip_sent_norm=args.clip_sent_norm)
+                                        clip_sent_norm=args.clip_sent_norm,
+                                        guard_cfg=guard_cfg, chaos=chaos)
         ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
         if args.resume:
             from tpu_compressed_dp.train.pp_step import place_pp_state
@@ -220,6 +230,7 @@ def run(args) -> Dict[str, float]:
             params, {}, opt.init(params), init_lm_ef_state(cfg, params, comp, mesh),
             jax.random.key(args.seed + 1),
             comp=init_lm_comp_state(cfg, params, comp, mesh),
+            guard=init_guard_state(guard_cfg),
         )
         ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
         if args.resume:
@@ -233,7 +244,8 @@ def run(args) -> Dict[str, float]:
 
         train_step = make_lm_train_step(cfg, opt, comp, mesh,
                                         clip_norm=args.clip_norm,
-                                        clip_sent_norm=args.clip_sent_norm)
+                                        clip_sent_norm=args.clip_sent_norm,
+                                        guard_cfg=guard_cfg, chaos=chaos)
     mesh_str = (f"dp{dp}xsp{args.sp}xpp{args.pp}xtp{args.tp}(mb{args.microbatches})" if pipelined
                 else f"dp{dp}xsp{args.sp}xtp{args.tp}")
     print(f"params={n_params/1e6:.1f}M mesh={mesh_str} "
@@ -241,56 +253,88 @@ def run(args) -> Dict[str, float]:
           f"method={comp.method or 'dense'}/{comp.granularity}/{comp.mode}")
 
     table = TableLogger()
+    from tpu_compressed_dp.utils.meters import GuardMeter
+
+    guard_meter = GuardMeter()
+    from tpu_compressed_dp.harness.loop import make_heartbeat
+
+    hb = make_heartbeat(args)
     t0 = time.time()
     tokens_done = 0.0
     summary: Dict[str, float] = {}
     start = int(state.step)
     timed_from = start
-    for step_i in range(start, args.steps):
-        batch = ds.batch(step_i)
-        state, metrics = train_step(
-            state, {k: jnp.asarray(v) for k, v in batch.items()})
-        if step_i <= start + 1:
-            # steady-state tokens/sec: the jitted step compiles TWICE (the
-            # donated-buffer layouts change the arg signature on call 2), so
-            # barrier-and-reset after each of the first two steps — one
-            # excluded step would leak the second compile (18s+ at 125M
-            # params) into the timed window
-            jax.device_get(metrics)
-            t0 = time.time()
-            timed_from = step_i + 1
-        if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
-            m = jax.device_get(metrics)
-            steps_timed = step_i + 1 - timed_from
-            tokens_done = steps_timed * args.global_batch * args.seq_len
-            dt = time.time() - t0
-            summary = {
-                "step": step_i + 1,
-                "loss": float(m["loss"]),
-                "lr": float(m["lr"]),
-                # 0.0 until at least one post-compile step is in the window
-                "tok/s": round(tokens_done / dt, 1) if steps_timed > 0 else 0.0,
-            }
-            if steps_timed > 0:
-                # MFU (VERDICT r2 #3): closed-form 6N + 12Lds per token
-                # (utils/flops.py), per chip, vs the chip's bf16 peak
-                from tpu_compressed_dp.utils import flops as flops_mod
+    # finally-guarded: GuardExceeded / ChaosCrash must not leak the
+    # heartbeat writer thread or the checkpoint manager; the final save
+    # stays on the clean path only
+    try:
+        for step_i in range(start, args.steps):
+            if crash is not None:
+                crash.check(step_i)
+            batch = ds.batch(step_i)
+            state, metrics = train_step(
+                state, {k: jnp.asarray(v) for k, v in batch.items()})
+            if step_i <= start + 1:
+                # steady-state tokens/sec: the jitted step compiles TWICE (the
+                # donated-buffer layouts change the arg signature on call 2), so
+                # barrier-and-reset after each of the first two steps — one
+                # excluded step would leak the second compile (18s+ at 125M
+                # params) into the timed window
+                jax.device_get(metrics)
+                t0 = time.time()
+                timed_from = step_i + 1
+            if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
+                m = jax.device_get(metrics)
+                if guard_cfg is not None:
+                    # wedge check at log cadence (detection latency = log_every)
+                    from tpu_compressed_dp.train.guard import check_guard_metrics
 
-                tok_flops = flops_mod.transformer_train_flops_per_token(
-                    n_params, cfg.n_layers, cfg.dim, args.seq_len)
-                n_chips = max(len(jax.devices()), 1)
-                u = flops_mod.mfu(tok_flops * (tokens_done / dt) / n_chips)
-                if u is not None:
-                    summary["mfu"] = round(u, 4)
-            if "comm/sent_elems" in m:
-                summary["sent frac"] = float(m["comm/sent_elems"]) / max(
-                    float(m["comm/dense_elems"]), 1.0)
-                summary["wire frac"] = float(m["comm/sent_bits"]) / (
-                    32.0 * max(float(m["comm/dense_elems"]), 1.0))
-            table.append(summary)
-    if ckpt:
-        ckpt.save(state, {"step": int(state.step)})
-        ckpt.close()
+                    guard_meter.update(m, step_i + 1)
+                    check_guard_metrics(m, guard_cfg)
+                if hb is not None:
+                    hb.update(
+                        step=step_i + 1,
+                        last_good_step=(int(m["guard/last_good_step"])
+                                        if guard_cfg is not None else step_i + 1),
+                    )
+                steps_timed = step_i + 1 - timed_from
+                tokens_done = steps_timed * args.global_batch * args.seq_len
+                dt = time.time() - t0
+                summary = {
+                    "step": step_i + 1,
+                    "loss": float(m["loss"]),
+                    "lr": float(m["lr"]),
+                    # 0.0 until at least one post-compile step is in the window
+                    "tok/s": round(tokens_done / dt, 1) if steps_timed > 0 else 0.0,
+                }
+                if steps_timed > 0:
+                    # MFU (VERDICT r2 #3): closed-form 6N + 12Lds per token
+                    # (utils/flops.py), per chip, vs the chip's bf16 peak
+                    from tpu_compressed_dp.utils import flops as flops_mod
+
+                    tok_flops = flops_mod.transformer_train_flops_per_token(
+                        n_params, cfg.n_layers, cfg.dim, args.seq_len)
+                    n_chips = max(len(jax.devices()), 1)
+                    u = flops_mod.mfu(tok_flops * (tokens_done / dt) / n_chips)
+                    if u is not None:
+                        summary["mfu"] = round(u, 4)
+                if "comm/sent_elems" in m:
+                    summary["sent frac"] = float(m["comm/sent_elems"]) / max(
+                        float(m["comm/dense_elems"]), 1.0)
+                    summary["wire frac"] = float(m["comm/sent_bits"]) / (
+                        32.0 * max(float(m["comm/dense_elems"]), 1.0))
+                if guard_cfg is not None:
+                    gsum = guard_meter.summary()
+                    summary["skipped"] = gsum.get("guard/skipped", 0.0)
+                    summary["loss_scale"] = gsum.get("guard/loss_scale", 1.0)
+                table.append(summary)
+        if ckpt:
+            ckpt.save(state, {"step": int(state.step)})
+    finally:
+        if hb is not None:
+            hb.stop()
+        if ckpt:
+            ckpt.close()
     return summary
 
 

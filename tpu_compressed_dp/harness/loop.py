@@ -18,7 +18,72 @@ from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.utils.loggers import MetricAccumulator
 from tpu_compressed_dp.utils.timer import Timer
 
-__all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch", "comm_summary"]
+__all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
+           "comm_summary", "guard_summary", "add_robustness_args",
+           "build_robustness", "make_heartbeat"]
+
+
+def add_robustness_args(p, *, check_note: str) -> None:
+    """The shared ``--guard*`` / ``--chaos`` / ``--heartbeat`` CLI surface
+    (one definition for all three harnesses; ``check_note`` names the
+    harness's wedge-check cadence in the --guard_max_skips help)."""
+    p.add_argument("--guard", action="store_true",
+                   help="arm the in-graph step guard: cross-worker "
+                        "finiteness vote skips nonfinite steps, holds "
+                        "params/ef/comp bitwise, dynamic loss scaling on "
+                        "16-bit dtypes (train/guard.py)")
+    p.add_argument("--guard_init_scale", type=float, default=2.0 ** 15)
+    p.add_argument("--guard_backoff", type=float, default=0.5)
+    p.add_argument("--guard_growth_interval", type=int, default=200)
+    p.add_argument("--guard_max_skips", type=int, default=25,
+                   help="raise GuardExceeded past this many CONSECUTIVE "
+                        f"skipped steps ({check_note})")
+    p.add_argument("--chaos", type=str, default=None,
+                   help="deterministic fault injection, e.g. "
+                        "'nan,target=grads,steps=3+7,worker=1' or "
+                        "'crash=120' (utils/chaos.py; in-graph injection "
+                        "auto-arms --guard)")
+    p.add_argument("--heartbeat", type=str, default=None,
+                   help="liveness JSON path (utils/resilience.Heartbeat); "
+                        "payload carries step + last_good_step")
+    p.add_argument("--heartbeat_interval", type=float, default=10.0)
+
+
+def make_heartbeat(args):
+    """The harnesses' ``--heartbeat`` setup: a started Heartbeat, or None."""
+    if not args.heartbeat:
+        return None
+    from tpu_compressed_dp.utils.resilience import Heartbeat
+
+    return Heartbeat(args.heartbeat, interval_s=args.heartbeat_interval,
+                     payload={"rank": jax.process_index()})
+
+
+def build_robustness(args, dtype):
+    """Resolve the shared ``--guard*`` / ``--chaos`` CLI surface (all three
+    harnesses) into ``(guard_cfg, chaos, crash_injector)``.
+
+    In-graph chaos injection auto-arms the guard: injecting NaN without the
+    guard poisons EF/compressor state permanently, which is only ever wanted
+    as the explicit control arm of a drill (tools/chaos_drill.py constructs
+    that case directly).  Loss scaling activates per ``dtype``
+    (``GuardConfig.for_dtype``): dynamic on 16-bit floats, identity on fp32.
+    """
+    from tpu_compressed_dp.train.guard import GuardConfig, init_guard_state  # noqa: F401
+    from tpu_compressed_dp.utils.chaos import ChaosConfig, maybe_crash_injector
+
+    chaos = ChaosConfig.parse(args.chaos) if args.chaos else None
+    want_guard = args.guard or (chaos is not None and chaos.injects_in_graph)
+    if want_guard and not args.guard and jax.process_index() == 0:
+        print("chaos: in-graph injection requested — arming the step guard")
+    guard_cfg = GuardConfig.for_dtype(
+        dtype,
+        init_scale=args.guard_init_scale,
+        backoff=args.guard_backoff,
+        growth_interval=args.guard_growth_interval,
+        max_consecutive_skips=args.guard_max_skips,
+    ) if want_guard else None
+    return guard_cfg, chaos, maybe_crash_injector(chaos)
 
 
 def comm_summary(acc: "MetricAccumulator") -> Dict[str, float]:
@@ -32,6 +97,18 @@ def comm_summary(acc: "MetricAccumulator") -> Dict[str, float]:
     return {
         "sent frac": acc.mean("comm/sent_elems") / dense,
         "wire frac": acc.mean("comm/sent_bits") / (32.0 * dense),
+    }
+
+
+def guard_summary(acc: "MetricAccumulator") -> Dict[str, float]:
+    """Epoch step-guard accounting: 'skipped' = cumulative vetoed steps
+    (end-of-epoch value of the monotone counter), 'loss scale' = the live
+    dynamic loss scale.  Empty when the guard is off."""
+    if "guard/nonfinite" not in acc.sums:
+        return {}
+    return {
+        "skipped": acc.last.get("guard/skipped", 0.0),
+        "loss scale": acc.last.get("guard/loss_scale", 1.0),
     }
 
 
@@ -52,18 +129,36 @@ def pad_batch(batch: Dict[str, np.ndarray], size: int) -> Dict[str, np.ndarray]:
     return {"input": x, "target": y, "mask": mask}
 
 
-def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict]) -> Tuple[TrainState, MetricAccumulator]:
+def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
+                    *, crash=None, step_offset: int = 0, guard_cfg=None,
+                    ) -> Tuple[TrainState, MetricAccumulator]:
     # Metrics stay on device until the epoch ends: a per-step float() would
     # block host batch prep on the device and serialize the pipeline (JAX's
     # async dispatch is the overlap the reference engineered with side
     # streams).  The final device_get blocks, so epoch wall-times stay honest.
+    #
+    # ``crash`` (utils/chaos.CrashInjector) fires the host-side chaos fault
+    # before dispatching the matching global step (= step_offset + i, the
+    # attempted-step counter — the same numbering the in-graph injection
+    # reads from TrainState.step).  ``guard_cfg`` arms the wedge check: the
+    # consecutive-skip streak is inspected on the fetched metrics at epoch
+    # end (per-step checks would force a device sync each step and
+    # serialize the pipeline; detection latency here is one epoch, and the
+    # raise lands inside run_with_recovery's retry loop like any failure).
     acc = MetricAccumulator()
     step_metrics = []
-    for batch in batches:
+    for i, batch in enumerate(batches):
+        if crash is not None:
+            crash.check(step_offset + i)
         state, metrics = train_step(state, {k: jnp.asarray(v) for k, v in batch.items()})
         step_metrics.append(metrics)
-    for metrics in jax.device_get(step_metrics):
+    fetched = jax.device_get(step_metrics)
+    for metrics in fetched:
         acc.update(metrics)
+    if guard_cfg is not None and fetched:
+        from tpu_compressed_dp.train.guard import check_guard_metrics
+
+        check_guard_metrics(fetched[-1], guard_cfg)
     return state, acc
 
 
@@ -92,10 +187,16 @@ def train_epoch(
     timer: Timer,
     batch_size: int,
     test_time_in_total: bool = False,
+    crash=None,
+    step_offset: int = 0,
+    guard_cfg=None,
 ) -> Tuple[TrainState, Dict[str, float]]:
     """One train + eval pass with the reference's epoch-summary shape
-    (`core.py:324-331`)."""
-    state, train_acc = run_train_epoch(train_step, state, train_batches)
+    (`core.py:324-331`).  ``crash``/``step_offset``/``guard_cfg`` pass
+    through to :func:`run_train_epoch`."""
+    state, train_acc = run_train_epoch(
+        train_step, state, train_batches, crash=crash,
+        step_offset=step_offset, guard_cfg=guard_cfg)
     train_time = timer()
     test_stats = run_eval(eval_step, state, test_batches, batch_size)
     test_time = timer(test_time_in_total)
@@ -109,4 +210,5 @@ def train_epoch(
         "total time": timer.total_time,
     }
     summary.update(comm_summary(train_acc))
+    summary.update(guard_summary(train_acc))
     return state, summary

@@ -25,10 +25,21 @@ Two payload modes (SURVEY.md §2.3 item 6):
   * ``mode='wire'`` — genuinely sparse payloads (packed k values; see
     :mod:`tpu_compressed_dp.ops.wire`), the `RandomKSparsifiedDDP` equivalent.
 
-Stateful compressors: every sync is ``sync(grads, ef, comp, key) ->
+Stateful compressors: every sync is ``sync(grads, ef, comp, key[, ok]) ->
 (synced, new_ef, new_comp, stats)`` — ``comp`` is a persistent compressor
 state pytree threaded through the jitted step alongside the EF residual
-(``()`` for the stateless element-wise methods).  The first occupant is
+(``()`` for the stateless element-wise methods).
+
+Step guard (``ok``): the optional keyword is the globally-voted finiteness
+verdict from :mod:`tpu_compressed_dp.train.guard`.  When given, BOTH engines
+(element-wise/wire and PowerSGD) gate themselves: local gradients are zeroed
+on a bad step (every downstream collective stays finite — the wire scatter
+paths have a documented finite-input precondition) and, critically, the
+persistent EF residual and compressor state are held bitwise at their
+pre-step values — a single poisoned gradient must not enter state that
+replays across every future step.  The stats gain ``guard/nonfinite``
+(1.0 = this step was vetoed).  ``ok=None`` (the default) is the exact
+pre-guard behaviour.  The first occupant is
 PowerSGD (``method='powersgd'``, :mod:`tpu_compressed_dp.ops.lowrank`),
 whose warm-start ``Q`` factors live in ``TrainState.comp``, are sharded
 like ``ef``, and round-trip through Orbax checkpoints; its payloads are
@@ -151,6 +162,52 @@ def make_sharded_clip(is_sharded, shard_axis):
     """Binary convenience wrapper over :func:`make_partitioned_clip`."""
     axes = (shard_axis,) if isinstance(shard_axis, str) else tuple(shard_axis)
     return make_partitioned_clip([axes if s else () for s in is_sharded])
+
+
+# Stats that are 0/1 diagnostics, identical across ranks (or min/max
+# verdicts), NOT additive volumes: the partitioned sync must not psum them
+# over model axes or sum them across signature groups.  Maps key -> the
+# (cross-rank collective, cross-group combiner) pair.
+_DIAG_STATS = {
+    "sync_agree": (jax.lax.pmin, jnp.minimum),
+    "guard/nonfinite": (jax.lax.pmax, jnp.maximum),
+}
+
+
+def _with_guard(inner_sync):
+    """Give a ``sync(grads, ef, comp, key)`` engine the optional step-guard
+    gate (``ok`` = the globally-voted finiteness verdict,
+    :func:`tpu_compressed_dp.train.guard.finite_vote`).
+
+    On a vetoed step the engine's job is damage containment: the local
+    gradients are replaced with zeros (so every collective — psum,
+    all_gather, the sharded transport's scatter/all_to_all, whose index
+    arithmetic has a documented finite-input precondition — computes on
+    finite data), and the persistent EF residual and compressor state come
+    back bitwise equal to their inputs instead of absorbing either the
+    poison or the zeroed-gradient artifact (with EF on, a zero gradient
+    would still rotate ``compress(ef)`` out of the residual).  The synced
+    output is then compression noise the caller discards along with the
+    whole update.
+    """
+    # lazy: a module-level `from tpu_compressed_dp.train.guard import ...`
+    # would cycle (train/__init__ -> step -> this module); by factory time
+    # everything is loaded
+    from tpu_compressed_dp.train.guard import select_tree
+
+    def sync(grads: Any, ef: Any, comp: Any, key: jax.Array,
+             ok: Optional[jax.Array] = None):
+        if ok is None:
+            return inner_sync(grads, ef, comp, key)
+        safe = jax.tree.map(lambda g: jnp.where(ok, g, jnp.zeros_like(g)),
+                            grads)
+        out, new_ef, new_comp, stats = inner_sync(safe, ef, comp, key)
+        stats = dict(stats)
+        stats["guard/nonfinite"] = (~ok).astype(jnp.float32)
+        return out, select_tree(ok, new_ef, ef), \
+            select_tree(ok, new_comp, comp), stats
+
+    return sync
 
 
 @dataclasses.dataclass(frozen=True)
@@ -456,7 +513,9 @@ def group_split(flat, leaves, idxs, out, dtype=None):
 
 
 def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
-    """Build ``sync(grads, ef, comp, key) -> (synced, new_ef, new_comp, stats)``.
+    """Build ``sync(grads, ef, comp, key[, ok]) -> (synced, new_ef, new_comp,
+    stats)`` (``ok`` is the step guard's finiteness verdict — see
+    :func:`_with_guard`; omit it for ungated behaviour).
 
     Must be called *inside* ``shard_map`` (uses ``lax.psum`` / ``axis_index``
     over ``axis_name``).  ``grads`` are the local worker's gradients at the
@@ -484,7 +543,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     if comp.name == "powersgd":
         # stateful warm-started path; the factors ARE the wire form, so
         # simulate and wire modes share it
-        return _make_powersgd_sync(cfg, axis_name)
+        return _with_guard(_make_powersgd_sync(cfg, axis_name))
     if cfg.mode == "wire" and comp.name != "none":
         # Dense (method=None) has no sparse representation — the simulate
         # path's full-size psum IS its wire format, so fall through.
@@ -496,7 +555,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
             out, new_ef, stats = wire_sync(grads, ef, key)
             return out, new_ef, comp_state, stats
 
-        return sync_wire
+        return _with_guard(sync_wire)
     per_worker_rng = not cfg.resolved_shared_mask
     bits_per_elem = compressors.payload_bits_per_elem(
         comp.name, qstates=cfg.qstates, shared_mask=cfg.resolved_shared_mask,
@@ -625,7 +684,7 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         }
         return out, new_ef, comp_state, stats
 
-    return sync
+    return _with_guard(sync)
 
 
 def _make_powersgd_sync(cfg: CompressionConfig, axis_name):
@@ -778,7 +837,7 @@ def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
         leaves = [next(its[g]) for g in group_of]
         return jax.tree.unflatten(jax.tree.structure(like), leaves)
 
-    def sync(grads, ef, comp, key):
+    def sync(grads, ef, comp, key, ok=None):
         use_ef = cfg.error_feedback
         g_groups = split(grads)
         e_groups = split(ef) if use_ef else [() for _ in sigs]
@@ -790,16 +849,18 @@ def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
                         if isinstance(comp, dict) else ())
             s_g, s_e, s_comp, s_comm = base_sync(
                 g_groups[gi], e_groups[gi] if use_ef else (), sub_comp,
-                keys[gi])
+                keys[gi], ok=ok)
             if s_comp != ():
                 new_comp[f"sig{gi}"] = s_comp
             out_g.append(s_g)
             out_e.append(s_e)
             if sig:
-                # sync_agree is a 0/1 min-diagnostic, not an additive volume:
-                # psum over the signature axes (or summing across groups
-                # below) would inflate a unanimous 1.0 to the rank count.
-                s_comm = {k: (jax.lax.pmin(v, sig) if k == "sync_agree"
+                # Diagnostics (sync_agree, guard/nonfinite) are 0/1 verdicts,
+                # not additive volumes: psum over the signature axes (or
+                # summing across groups below) would inflate a unanimous
+                # value to the rank count — reduce them with their own
+                # collective instead.
+                s_comm = {k: (_DIAG_STATS[k][0](v, sig) if k in _DIAG_STATS
                               else jax.lax.psum(v, sig))
                           for k, v in s_comm.items()}
             if comm is None:
@@ -807,19 +868,17 @@ def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
             else:
                 merged = {
                     k: comm.get(k, 0.0) + s_comm.get(k, 0.0)
-                    for k in (set(comm) | set(s_comm)) - {"sync_agree"}
+                    for k in (set(comm) | set(s_comm)) - set(_DIAG_STATS)
                 }
-                # keep the diagnostic when EITHER side reports it: a
-                # signature of dense-fallback-only groups emits no
-                # sync_agree, and dropping the other side's value would
-                # silence exactly the divergence signal check_sync exists
-                # to surface
-                agree_vals = [c["sync_agree"] for c in (comm, s_comm)
-                              if "sync_agree" in c]
-                if agree_vals:
-                    merged["sync_agree"] = (
-                        agree_vals[0] if len(agree_vals) == 1
-                        else jnp.minimum(*agree_vals))
+                # keep a diagnostic when EITHER side reports it: a signature
+                # of dense-fallback-only groups emits no sync_agree, and
+                # dropping the other side's value would silence exactly the
+                # divergence signal check_sync exists to surface
+                for k, (_, combine) in _DIAG_STATS.items():
+                    vals = [c[k] for c in (comm, s_comm) if k in c]
+                    if vals:
+                        merged[k] = (vals[0] if len(vals) == 1
+                                     else combine(*vals))
                 comm = merged
         synced = merge(grads, out_g)
         new_ef = merge(ef, out_e) if use_ef else ()

@@ -1,0 +1,273 @@
+"""In-graph step guard: cross-worker finiteness vote + dynamic loss scaling.
+
+The stack carries persistent cross-step state on every worker — the
+error-feedback residual (``TrainState.ef``) and PowerSGD's warm-started
+factors (``TrainState.comp``) — so a single nonfinite gradient does not just
+ruin one step: once absorbed, the poison replays out of the residual forever.
+The reference had nothing here at all; `utils/resilience.py` gives the
+host-level half (crash -> restore -> replay) and this module gives the
+in-graph half:
+
+  * every step, each worker reduces ``isfinite`` over its loss and local
+    gradients and the workers take a **vote** (one ``psum`` of the nonfinite
+    counts over the sync axes).  The psum is symmetric, so every worker —
+    including ones whose own gradients were clean — computes the identical
+    verdict and takes the identical branch; there is no rank-0 broadcast to
+    race;
+  * on a bad step the update is **skipped**: params, optimizer buffers,
+    batch stats, EF residual and compressor state are all held bitwise at
+    their pre-step values (the sync engines gate EF/comp internally, see
+    ``parallel/dp.py``), and the **dynamic loss scale** backs off;
+  * on good steps the scale regrows after ``growth_interval`` consecutive
+    successes — the standard fp16 dynamic-loss-scaling protocol
+    (`fp16util.py`'s static ``loss_scale=1024`` is the reference's whole
+    story; bf16 rarely overflows but underflows the same 8-bit exponent as
+    fp32 never would at half precision, so the fp16/bf16 paths get the full
+    dynamic protocol and fp32 gets the identity scale);
+  * the consecutive-skip streak lives in :class:`GuardState` (checkpointed
+    with everything else); past ``max_consecutive_skips`` the host raises
+    :class:`GuardExceeded` — a wedged run (e.g. corrupted data shard feeding
+    NaNs every step) fails loudly into ``run_with_recovery`` instead of
+    silently burning its epoch budget skipping.
+
+Everything here runs *inside* the jitted step except
+:func:`check_guard_metrics` (a host-side assertion over fetched metrics;
+raising is impossible inside jit without checkify's overhead on every step).
+
+Fault-injection counterpart: :mod:`tpu_compressed_dp.utils.chaos`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+Array = jax.Array
+
+__all__ = [
+    "GuardConfig", "GuardState", "GuardExceeded", "init_guard_state",
+    "tree_all_finite", "finite_vote", "select_tree", "update_guard",
+    "guard_metrics", "check_guard_metrics", "worker_index",
+    "guard_to_dict", "guard_from_dict",
+]
+
+
+class GuardExceeded(RuntimeError):
+    """Raised (host-side) when the consecutive-skip streak passes
+    ``GuardConfig.max_consecutive_skips`` — the run is wedged, not unlucky."""
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """Step-guard knobs.
+
+    init_scale:            starting loss scale (active only with
+                           ``loss_scaling``; the classic fp16 default is a
+                           large power of two so backoff finds the usable
+                           range fast)
+    backoff:               multiplier on a nonfinite step (0.5 = halve)
+    growth:                multiplier after ``growth_interval`` good steps
+    growth_interval:       consecutive good steps before the scale regrows
+    max_consecutive_skips: host-side raise threshold (strictly-greater-than);
+                           see :func:`check_guard_metrics`
+    loss_scaling:          False pins the scale to 1.0 (the fp32 identity
+                           path — the vote/skip machinery still runs)
+    """
+
+    init_scale: float = 2.0 ** 15
+    backoff: float = 0.5
+    growth: float = 2.0
+    growth_interval: int = 200
+    max_consecutive_skips: int = 25
+    loss_scaling: bool = True
+
+    def __post_init__(self):
+        if not (0.0 < self.backoff < 1.0):
+            raise ValueError(f"backoff must be in (0, 1), got {self.backoff}")
+        if self.growth <= 1.0:
+            raise ValueError(f"growth must be > 1, got {self.growth}")
+        if self.growth_interval < 1:
+            raise ValueError(
+                f"growth_interval must be >= 1, got {self.growth_interval}")
+        if self.init_scale < 1.0:
+            raise ValueError(
+                f"init_scale must be >= 1, got {self.init_scale} "
+                "(the scale is clamped to >= 1 by backoff anyway)")
+        if self.max_consecutive_skips < 1:
+            raise ValueError(
+                f"max_consecutive_skips must be >= 1, got "
+                f"{self.max_consecutive_skips}")
+
+    @classmethod
+    def for_dtype(cls, dtype, **kw) -> "GuardConfig":
+        """Loss scaling active on the 16-bit float paths, identity on fp32
+        (a pow-2 scale would be exact there anyway; identity keeps the fp32
+        guarded step equal to the unguarded one up to psum reduction order
+        — the guarded program compiles separately, so XLA may pick a
+        different all-reduce tree)."""
+        dt = jnp.dtype(dtype)
+        scaling = jnp.issubdtype(dt, jnp.floating) and dt.itemsize <= 2
+        return cls(loss_scaling=kw.pop("loss_scaling", scaling), **kw)
+
+
+@struct.dataclass
+class GuardState:
+    """The guard's cross-step carry, one more ``TrainState`` occupant: it is
+    replicated (the vote makes every field identical on every worker),
+    round-trips Orbax (``utils/checkpoint.py``) and therefore replays
+    bit-identically through ``run_with_recovery``."""
+
+    loss_scale: Array       # f32 scalar, >= 1.0
+    good_steps: Array       # i32 consecutive good steps since last scale event
+    skips: Array            # i32 CONSECUTIVE skipped steps (streak)
+    total_skipped: Array    # i32 total skipped steps (monotone)
+    last_good_step: Array   # i32 step counter after the last applied update
+
+
+def init_guard_state(cfg: Optional[GuardConfig]) -> Any:
+    """Fresh :class:`GuardState` (``()`` when the guard is off, mirroring
+    ``ef``/``comp``).
+
+    Each field gets its OWN zero array: sharing one ``jnp.asarray(0)``
+    across fields aliases their device buffers, and a donating jitted step
+    (``donate=True``, the harness default) then fails with "attempt to
+    donate the same buffer twice".
+    """
+    if cfg is None:
+        return ()
+    scale = cfg.init_scale if cfg.loss_scaling else 1.0
+    return GuardState(
+        loss_scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32),
+        skips=jnp.zeros((), jnp.int32),
+        total_skipped=jnp.zeros((), jnp.int32),
+        last_good_step=jnp.zeros((), jnp.int32),
+    )
+
+
+def guard_to_dict(gs: GuardState) -> Dict[str, Array]:
+    """Plain-dict form for Orbax (a vanilla nested dict needs no pytree
+    registration agreement between the writing and reading process)."""
+    return {f.name: getattr(gs, f.name) for f in dataclasses.fields(gs)}
+
+
+def guard_from_dict(d: Dict[str, Any]) -> GuardState:
+    return GuardState(
+        loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+        good_steps=jnp.asarray(d["good_steps"], jnp.int32),
+        skips=jnp.asarray(d["skips"], jnp.int32),
+        total_skipped=jnp.asarray(d["total_skipped"], jnp.int32),
+        last_good_step=jnp.asarray(d["last_good_step"], jnp.int32),
+    )
+
+
+def tree_all_finite(*trees: Any) -> Array:
+    """Scalar bool: every float leaf of every tree is finite.  Integer
+    leaves are skipped (isfinite is vacuous there)."""
+    ok = jnp.asarray(True)
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+                ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def finite_vote(local_ok: Array, axis_names: Union[str, Sequence[str]]) -> Array:
+    """Cross-worker vote: globally ok iff EVERY worker's local verdict is ok.
+
+    One psum of the nonfinite counts over ``axis_names`` — symmetric, so the
+    result (and hence the skip branch) is identical on every participant; a
+    single poisoned worker vetoes the whole update."""
+    bad = (~local_ok).astype(jnp.int32)
+    return jax.lax.psum(bad, axis_names) == 0
+
+
+def worker_index(axis_names: Union[str, Sequence[str]]) -> Array:
+    """Linearised worker index over one or more mesh axes (row-major in the
+    given order) — the coordinate chaos injection targets."""
+    if isinstance(axis_names, str):
+        return jax.lax.axis_index(axis_names)
+    idx = jnp.asarray(0, jnp.int32)
+    for ax in axis_names:
+        idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
+    return idx
+
+
+def select_tree(ok: Array, new: Any, old: Any) -> Any:
+    """Per-leaf ``where(ok, new, old)``; the held branch is the *input* leaf
+    itself so a skipped step is bitwise the pre-step state."""
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+def update_guard(cfg: GuardConfig, gs: GuardState, ok: Array,
+                 new_step: Array) -> GuardState:
+    """One transition of the guard state machine.
+
+    good: streak resets, good_steps advances, the scale grows ``x growth``
+    every ``growth_interval`` consecutive good steps.  bad: streak and total
+    advance, good_steps resets, the scale backs off (clamped to >= 1 — below
+    1 the "scale" would start destroying fp32 mantissa instead of protecting
+    half-precision exponent).  With ``loss_scaling`` off the scale is pinned.
+    """
+    good = jnp.where(ok, gs.good_steps + 1, 0)
+    if cfg.loss_scaling:
+        grow = good >= cfg.growth_interval
+        scale = jnp.where(
+            ok,
+            jnp.where(grow, gs.loss_scale * cfg.growth, gs.loss_scale),
+            jnp.maximum(gs.loss_scale * cfg.backoff, 1.0),
+        )
+        good = jnp.where(grow, 0, good)
+    else:
+        scale = gs.loss_scale
+    return GuardState(
+        loss_scale=scale,
+        good_steps=good.astype(jnp.int32),
+        skips=jnp.where(ok, 0, gs.skips + 1).astype(jnp.int32),
+        total_skipped=(gs.total_skipped + (~ok).astype(jnp.int32)),
+        last_good_step=jnp.where(ok, new_step,
+                                 gs.last_good_step).astype(jnp.int32),
+    )
+
+
+def guard_metrics(gs: GuardState) -> Dict[str, Array]:
+    """The post-update guard scalars for the step's metrics dict (all
+    replicated — the vote made every field identical across workers).
+    ``guard/nonfinite`` itself is reported by the sync engines
+    (``parallel/dp.py``), which own the EF/comp hold."""
+    f32 = jnp.float32
+    return {
+        "guard/loss_scale": gs.loss_scale.astype(f32),
+        "guard/skipped": gs.total_skipped.astype(f32),
+        "guard/skip_streak": gs.skips.astype(f32),
+        "guard/last_good_step": gs.last_good_step.astype(f32),
+    }
+
+
+def check_guard_metrics(metrics: Dict[str, Any],
+                        cfg: GuardConfig) -> None:
+    """Host-side wedge detector: raise :class:`GuardExceeded` when the
+    consecutive-skip streak has passed ``max_consecutive_skips``.
+
+    Called on *fetched* metrics (after ``device_get``), so detection latency
+    is whatever cadence the caller observes metrics at — per epoch in the
+    CNN harnesses (``harness/loop.py``), per ``--log_every`` in the LM
+    harness.  Raising inside the jitted step would need checkify's
+    every-step overhead; a wedged run burning one extra epoch of skips is
+    the cheaper failure mode, and the raise still lands inside
+    ``run_with_recovery``'s retry loop.
+    """
+    streak = metrics.get("guard/skip_streak")
+    if streak is None:
+        return
+    if float(streak) > cfg.max_consecutive_skips:
+        raise GuardExceeded(
+            f"step guard: {int(float(streak))} consecutive nonfinite steps "
+            f"(> max_consecutive_skips={cfg.max_consecutive_skips}); "
+            f"loss_scale={float(metrics.get('guard/loss_scale', -1.0)):g}, "
+            f"last_good_step={int(float(metrics.get('guard/last_good_step', -1)))}"
+        )

@@ -48,9 +48,12 @@ from tpu_compressed_dp.parallel.dp import (
     make_grouped_grad_sync,
     make_sharded_clip,
 )
+from tpu_compressed_dp.train import guard as guard_mod
+from tpu_compressed_dp.train.guard import GuardConfig
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import optimizer_lr
+from tpu_compressed_dp.utils import chaos as chaos_mod
 
 Array = jax.Array
 
@@ -131,6 +134,9 @@ def lm_state_specs(cfg: LlamaConfig, comp: CompressionConfig) -> TrainState:
         # worker axis, inner dims unsharded — build with
         # init_comp_state_grouped(..., num_devices=data*seq)
         comp=P(("data", "seq")),
+        # step-guard state: replicated (the finiteness vote makes it
+        # identical on every worker)
+        guard=P(),
     )
 
 
@@ -151,6 +157,8 @@ def make_lm_train_step(
     clip_norm: float = 0.0,
     clip_sent_norm: float = 0.0,
     donate: bool = True,
+    guard_cfg: Optional[GuardConfig] = None,
+    chaos: Optional["chaos_mod.ChaosConfig"] = None,
 ):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
@@ -162,6 +170,13 @@ def make_lm_train_step(
     post-aggregation L2 clip).  Norms span the FULL model gradient: squared
     norms of tensor-SHARDED leaves psum over the tensor axis; replicated
     leaves (already psum'd by shard_map AD) count once.
+
+    ``guard_cfg`` / ``chaos``: the step guard and fault injection of
+    :func:`tpu_compressed_dp.train.step.make_train_step`.  The finiteness
+    vote spans the WHOLE mesh (data, seq, tensor): a NaN on one tensor
+    shard's gradient slice must veto the update on every replica, or the
+    tensor-sharded params would de-synchronise.  Chaos targets one
+    (data, seq) compression worker across all its tensor shards.
     """
     cfg.validate_mesh(mesh.shape["tensor"])
     from tpu_compressed_dp.ops.compressors import canonical_name
@@ -185,9 +200,18 @@ def make_lm_train_step(
     grad_sync = make_grouped_grad_sync(comp_cfg, sync_axes, is_sharded, "tensor")
 
     clip_tree = make_sharded_clip(is_sharded, "tensor")
+    guarded = guard_cfg is not None
+    inject = chaos is not None and chaos.injects_in_graph
+    if inject and chaos.worker >= n_workers:
+        # silently-never-firing injection would fake a passing drill
+        raise ValueError(
+            f"chaos worker {chaos.worker} out of range for {n_workers} "
+            "(data x seq) workers")
 
     def local_step(state: TrainState, x: Array, y: Array):
         comp_key = jax.random.fold_in(state.rng, state.step)
+        ls_scale = (state.guard.loss_scale if guarded
+                    else jnp.asarray(1.0, jnp.float32))
 
         def loss_fn(params):
             # per-worker logits buffer: local tokens x vocab shard (V/tp)
@@ -208,19 +232,32 @@ def make_lm_train_step(
                                           tensor_axis="tensor",
                                           seq_axis="seq", with_aux=True)
                 xent = vocab_parallel_xent(logits, y, tensor_axis="tensor")
-            return xent + cfg.moe_aux_weight * aux, xent
+            # backprop at loss_scale x (identity unguarded/fp32); the raw
+            # xent rides along for metrics/vote
+            return (xent + cfg.moe_aux_weight * aux) * ls_scale, xent
 
         varying = jax.tree.map(
             lambda p: compat.pcast(p, sync_axes, to="varying"), state.params
         )
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying)
+        if inject:
+            loss, grads = chaos_mod.inject(
+                chaos, state.step, guard_mod.worker_index(sync_axes), loss,
+                grads)
+        ok = None
+        if guarded:
+            # vote over the FULL mesh: tensor-sharded gradient slices differ
+            # per shard, and every replica must take the identical branch
+            ok = guard_mod.finite_vote(
+                guard_mod.tree_all_finite(loss, grads), LM_AXES)
+            grads = jax.tree.map(lambda g: g / ls_scale, grads)
         if clip_norm > 0.0:
             grads = clip_tree(grads, clip_norm)
 
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
         comp_local = jax.tree.map(lambda c: c[0], state.comp)
         synced, new_ef, new_comp, comm = grad_sync(
-            grads, ef_local, comp_local, comp_key)
+            grads, ef_local, comp_local, comp_key, ok=ok)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
         new_comp = jax.tree.map(lambda c: c[None], new_comp)
         if clip_sent_norm > 0.0:
@@ -229,18 +266,28 @@ def make_lm_train_step(
         new_step = state.step + 1
         new_params, new_opt = optimizer.apply(state.params, synced,
                                               state.opt_state, new_step)
+        new_guard = state.guard
+        if guarded:
+            new_params = guard_mod.select_tree(ok, new_params, state.params)
+            new_opt = guard_mod.select_tree(ok, new_opt, state.opt_state)
+            new_guard = guard_mod.update_guard(guard_cfg, state.guard, ok,
+                                               new_step)
+            loss = jnp.where(ok, loss, 0.0)
         ntok = jnp.asarray(x.shape[0] * x.shape[1], jnp.float32)
         metrics = {
             "loss": jax.lax.pmean(loss, sync_axes),
             "tokens": jax.lax.psum(ntok, sync_axes),
             "lr": optimizer_lr(optimizer, new_step),
         }
+        if guarded:
+            metrics.update(guard_mod.guard_metrics(new_guard))
         for k, v in comm.items():
-            metrics[f"comm/{k}"] = jax.lax.pmean(v, sync_axes)
+            metrics[k if k.startswith("guard/") else f"comm/{k}"] = (
+                jax.lax.pmean(v, sync_axes))
 
         return dataclasses.replace(
             state, step=new_step, params=new_params, opt_state=new_opt,
-            ef=new_ef, comp=new_comp,
+            ef=new_ef, comp=new_comp, guard=new_guard,
         ), metrics
 
     state_spec = lm_state_specs(cfg, comp_cfg)
@@ -263,6 +310,10 @@ def make_lm_train_step(
                     f"(data x seq workers); got {leaf.shape} — build with "
                     "init_lm_ef_state(cfg, params, comp, mesh)"
                 )
+        if guarded and state.guard == ():
+            raise ValueError(
+                "guard_cfg set but state.guard is empty; build it with "
+                "init_guard_state(guard_cfg)")
         return jitted(state, batch["input"], batch["target"])
 
     return train_step

@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,9 +61,12 @@ from tpu_compressed_dp.parallel.dp import (
     make_partitioned_clip,
     make_partitioned_grad_sync,
 )
+from tpu_compressed_dp.train import guard as guard_mod
+from tpu_compressed_dp.train.guard import GuardConfig
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import optimizer_lr
+from tpu_compressed_dp.utils import chaos as chaos_mod
 
 Array = jax.Array
 
@@ -168,6 +171,8 @@ def pp_state_specs(cfg: LlamaConfig, comp: CompressionConfig,
         rng=P(),
         # compressor state (powersgd warm-start Q): leading worker axis only
         comp=P(worker_ax),
+        # step-guard state: replicated (global finiteness vote)
+        guard=P(),
     )
 
 
@@ -210,6 +215,8 @@ def make_pp_train_step(
     clip_norm: float = 0.0,
     clip_sent_norm: float = 0.0,
     donate: bool = True,
+    guard_cfg: Optional[GuardConfig] = None,
+    chaos: Optional["chaos_mod.ChaosConfig"] = None,
 ):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
@@ -221,6 +228,13 @@ def make_pp_train_step(
     (see :func:`tpu_compressed_dp.train.step.make_train_step`); norms span
     the full model — pipe-sharded layer stacks psum their squared norms
     over ``pipe``, replicated embed/head/norm leaves count once.
+
+    ``guard_cfg`` / ``chaos``: the step guard and fault injection of
+    :func:`tpu_compressed_dp.train.step.make_train_step`.  The finiteness
+    vote spans EVERY mesh axis (data[, seq], pipe[, tensor]): a NaN in one
+    stage's layer-stack gradient must veto the update on all stages, or the
+    pipeline's replicated embed/head params would de-synchronise from the
+    stage-local layers.
     """
     from tpu_compressed_dp.ops.compressors import canonical_name
 
@@ -271,9 +285,19 @@ def make_pp_train_step(
     clip_tree = make_partitioned_clip(leaf_axes)
     n_workers = mesh.shape["data"] * sp
     dt = cfg.dtype
+    guarded = guard_cfg is not None
+    inject = chaos is not None and chaos.injects_in_graph
+    if inject and chaos.worker >= n_workers:
+        # silently-never-firing injection would fake a passing drill
+        raise ValueError(
+            f"chaos worker {chaos.worker} out of range for {n_workers} "
+            "(data x seq) workers")
+    vote_axes = tuple(mesh.axis_names)
 
     def local_step(state: TrainState, x: Array, y: Array):
         comp_key = jax.random.fold_in(state.rng, state.step)
+        ls_scale = (state.guard.loss_scale if guarded
+                    else jnp.asarray(1.0, jnp.float32))
         stage = jax.lax.axis_index("pipe")
         b_local, t_len = x.shape
         mb = b_local // M
@@ -347,21 +371,35 @@ def make_pp_train_step(
                 nll = vocab_parallel_xent(
                     logits, my_y.reshape(m_s * mb, t_len),
                     tensor_axis=tensor_axis)
-            # equal chunks: mean of chunk-means == global mean
+            # equal chunks: mean of chunk-means == global mean; backprop at
+            # loss_scale x (identity unguarded/fp32)
             loss = jax.lax.psum(nll * scale, "pipe")
-            return loss
+            return loss * ls_scale
 
         varying = jax.tree.map(
             lambda p: compat.pcast(p, sync_axes, to="varying"), state.params
         )
         loss, grads = jax.value_and_grad(loss_fn)(varying)
+        loss = loss / ls_scale  # raw loss for metrics/vote (1.0 unguarded)
+        if inject:
+            loss, grads = chaos_mod.inject(
+                chaos, state.step, guard_mod.worker_index(sync_axes), loss,
+                grads)
+        ok = None
+        if guarded:
+            # vote over EVERY mesh axis: stage-local layer gradients differ
+            # per pipe (and tensor) shard, and all replicas must branch
+            # identically
+            ok = guard_mod.finite_vote(
+                guard_mod.tree_all_finite(loss, grads), vote_axes)
+            grads = jax.tree.map(lambda g: g / ls_scale, grads)
         if clip_norm > 0.0:
             grads = clip_tree(grads, clip_norm)
 
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
         comp_local = jax.tree.map(lambda c: c[0], state.comp)
         synced, new_ef, new_comp, comm = grad_sync(
-            grads, ef_local, comp_local, comp_key)
+            grads, ef_local, comp_local, comp_key, ok=ok)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
         new_comp = jax.tree.map(lambda c: c[None], new_comp)
         if clip_sent_norm > 0.0:
@@ -370,17 +408,27 @@ def make_pp_train_step(
         new_step = state.step + 1
         new_params, new_opt = optimizer.apply(state.params, synced,
                                               state.opt_state, new_step)
+        new_guard = state.guard
+        if guarded:
+            new_params = guard_mod.select_tree(ok, new_params, state.params)
+            new_opt = guard_mod.select_tree(ok, new_opt, state.opt_state)
+            new_guard = guard_mod.update_guard(guard_cfg, state.guard, ok,
+                                               new_step)
+            loss = jnp.where(ok, loss, 0.0)
         metrics = {
             "loss": jax.lax.pmean(loss, sync_axes),
             "tokens": jax.lax.psum(
                 jnp.asarray(b_local * t_len, jnp.float32), sync_axes),
             "lr": optimizer_lr(optimizer, new_step),
         }
+        if guarded:
+            metrics.update(guard_mod.guard_metrics(new_guard))
         for k, v in comm.items():
-            metrics[f"comm/{k}"] = jax.lax.pmean(v, sync_axes)
+            metrics[k if k.startswith("guard/") else f"comm/{k}"] = (
+                jax.lax.pmean(v, sync_axes))
         return dataclasses.replace(
             state, step=new_step, params=new_params, opt_state=new_opt,
-            ef=new_ef, comp=new_comp,
+            ef=new_ef, comp=new_comp, guard=new_guard,
         ), metrics
 
     state_spec = pp_state_specs(cfg, comp_cfg, tensor=tp > 1, seq=sp > 1)
@@ -402,6 +450,10 @@ def make_pp_train_step(
                     f"PP EF residual needs leading axis {n_workers}; got "
                     f"{leaf.shape} — build with init_pp_ef_state"
                 )
+        if guarded and state.guard == ():
+            raise ValueError(
+                "guard_cfg set but state.guard is empty; build it with "
+                "init_guard_state(guard_cfg)")
         return jitted(state, batch["input"], batch["target"])
 
     return train_step

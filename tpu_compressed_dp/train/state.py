@@ -8,6 +8,11 @@ comp}``.  ``comp`` is the persistent compressor state (PowerSGD warm-start
 factors, :func:`tpu_compressed_dp.parallel.dp.init_comp_state`): it shards
 and checkpoints exactly like the EF residual, so a resumed run keeps the
 power iteration's converged subspace instead of re-warming from random.
+``guard`` is the step guard's carry (dynamic loss scale + skip counters,
+:func:`tpu_compressed_dp.train.guard.init_guard_state`): replicated — the
+cross-worker finiteness vote makes every field identical on every worker —
+and checkpointed, so a restored run resumes with the loss scale it had
+found, not the (possibly overflowing) init.
 """
 
 from __future__ import annotations
@@ -32,10 +37,11 @@ class TrainState:
     ef: Any                    # error-feedback residual pytree, or () when off
     rng: jax.Array             # base PRNG key; per-step keys are folded from it
     comp: Any = ()             # compressor state (PowerSGD warm-start Q), or ()
+    guard: Any = ()            # step-guard state (GuardState), or () when off
 
     @classmethod
     def create(cls, params: Any, batch_stats: Any, opt_state: Any, ef: Any,
-               rng: jax.Array, comp: Any = ()):
+               rng: jax.Array, comp: Any = (), guard: Any = ()):
         return cls(
             step=jnp.asarray(0, jnp.int32),
             params=params,
@@ -44,6 +50,7 @@ class TrainState:
             ef=ef,
             rng=rng,
             comp=comp,
+            guard=guard,
         )
 
     def with_mesh_sharding(self, mesh: Mesh, axis_name: str = "data") -> "TrainState":
@@ -75,7 +82,7 @@ class TrainState:
         placed = {}
         for f in dataclasses.fields(self):
             val, spec = getattr(self, f.name), getattr(specs, f.name)
-            if f.name in ("ef", "comp") and val == ():
+            if f.name in ("ef", "comp", "guard") and val == ():
                 placed[f.name] = ()
             elif isinstance(spec, P):
                 placed[f.name] = jax.tree.map(lambda v: place(v, spec), val)

@@ -35,8 +35,11 @@ from tpu_compressed_dp import compat
 from tpu_compressed_dp.compat import shard_map
 
 from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grad_sync
+from tpu_compressed_dp.train import guard as guard_mod
+from tpu_compressed_dp.train.guard import GuardConfig
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.utils import chaos as chaos_mod
 
 Array = jax.Array
 
@@ -72,6 +75,8 @@ def make_train_step(
     clip_sent_norm: float = 0.0,
     axis_name: str = "data",
     donate: bool = True,
+    guard_cfg: Optional[GuardConfig] = None,
+    chaos: Optional["chaos_mod.ChaosConfig"] = None,
 ):
     """Build ``train_step(state, batch) -> (state, metrics)``, jitted over ``mesh``.
 
@@ -94,18 +99,45 @@ def make_train_step(
     steps and still releases it at once).  For Random-K + EF + momentum the
     bisect shows clip-sent ~20x lower final loss than clip-local alone;
     combine both for the most robust protocol.
+
+    ``guard_cfg`` (None = off) arms the in-graph step guard
+    (:mod:`tpu_compressed_dp.train.guard`): a cross-worker finiteness vote
+    over loss + gradients gates the whole update — on a bad step
+    params/opt_state/batch_stats/ef/comp are held bitwise, the dynamic loss
+    scale backs off, and the skip counters advance; ``state.guard`` must be
+    built with ``init_guard_state(guard_cfg)``.  The loss is multiplied by
+    the live scale before backprop and the gradients divided by it after
+    the vote (so a scale overflow is itself caught by the vote).
+
+    ``chaos`` (None = off) traces deterministic fault injection into the
+    step (:mod:`tpu_compressed_dp.utils.chaos`): NaN/Inf into one worker's
+    gradients or loss at step-counter-chosen steps — the adversary the
+    guard is tested against (tools/chaos_drill.py).
     """
     grad_sync = make_grad_sync(comp_cfg, axis_name)
+    guarded = guard_cfg is not None
+    inject = chaos is not None and chaos.injects_in_graph
+    if inject and chaos.worker >= mesh.shape[axis_name]:
+        # an out-of-range worker would silently never fire — the drill
+        # would then "pass" against faults that never happened
+        raise ValueError(
+            f"chaos worker {chaos.worker} out of range for "
+            f"{mesh.shape[axis_name]} data-parallel workers")
 
     def local_step(state: TrainState, x: Array, y: Array):
         step_key = jax.random.fold_in(state.rng, state.step)
         comp_key, drop_key = jax.random.split(step_key)
         drop_key = jax.random.fold_in(drop_key, jax.lax.axis_index(axis_name))
+        ls_scale = (state.guard.loss_scale if guarded
+                    else jnp.asarray(1.0, jnp.float32))
 
         def loss_fn(params):
             logits, new_bs = apply_fn(params, state.batch_stats, x, True, {"dropout": drop_key})
             loss = cross_entropy_sum(logits, y) / x.shape[0]  # local mean
-            return loss, (new_bs, logits)
+            # backprop the SCALED loss (identity when unguarded/fp32): the
+            # whole backward pass runs at loss_scale x, keeping tiny
+            # half-precision cotangents above the representable floor
+            return loss * ls_scale, (new_bs, logits, loss)
 
         # shard_map's AD would transparently psum gradients of replicated
         # params — but the whole point of this framework is to compress each
@@ -113,9 +145,20 @@ def make_train_step(
         # device-varying so jax.grad yields the per-worker local gradient and
         # the (possibly compressed) psum stays under our control in grad_sync.
         varying_params = jax.tree.map(lambda p: _to_varying(p, axis_name), state.params)
-        (loss, (new_bs, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying_params)
+        (_, (new_bs, logits, loss)), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying_params)
 
         scaled = jax.tree.map(lambda g: g.astype(jnp.float32) * grad_scale, grads)
+        if inject:
+            loss, scaled = chaos_mod.inject(
+                chaos, state.step, guard_mod.worker_index(axis_name), loss,
+                scaled)
+        ok = None
+        if guarded:
+            # vote BEFORE unscaling: an inf that the loss scale itself
+            # manufactured is exactly what dynamic backoff must see
+            ok = guard_mod.finite_vote(
+                guard_mod.tree_all_finite(loss, scaled), axis_name)
+            scaled = jax.tree.map(lambda g: g / ls_scale, scaled)
         if clip_norm > 0.0:
             # local-gradient clip at mean-loss scale: ||scaled|| / grad_scale
             # <= clip_norm after this (threshold stays protocol-invariant
@@ -130,7 +173,7 @@ def make_train_step(
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
         comp_local = jax.tree.map(lambda c: c[0], state.comp)
         synced, new_ef, new_comp, comm = grad_sync(
-            scaled, ef_local, comp_local, comp_key)
+            scaled, ef_local, comp_local, comp_key, ok=ok)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
         new_comp = jax.tree.map(lambda c: c[None], new_comp)
         if clip_sent_norm > 0.0:
@@ -148,6 +191,21 @@ def make_train_step(
         # (SURVEY.md §7 "BatchNorm under DP").
         new_bs = jax.lax.pmean(new_bs, axis_name) if new_bs else new_bs
 
+        new_guard = state.guard
+        if guarded:
+            # the vetoed branch holds EVERYTHING the step would have mutated
+            # (ef/comp were held inside grad_sync); only the step counter,
+            # the RNG stream (derived from it) and the guard's own
+            # bookkeeping advance
+            new_params = guard_mod.select_tree(ok, new_params, state.params)
+            new_opt = guard_mod.select_tree(ok, new_opt, state.opt_state)
+            new_bs = guard_mod.select_tree(ok, new_bs, state.batch_stats)
+            new_guard = guard_mod.update_guard(guard_cfg, state.guard, ok,
+                                               new_step)
+            # a nonfinite loss would poison the epoch mean; report 0 for the
+            # skipped step (its count still contributes — honest step totals)
+            loss = jnp.where(ok, loss, 0.0)
+
         local_bs = jnp.asarray(x.shape[0], jnp.float32)
         correct = jnp.sum(jnp.argmax(logits, axis=1) == y).astype(jnp.float32)
         metrics = {
@@ -156,8 +214,12 @@ def make_train_step(
             "count": jax.lax.psum(local_bs, axis_name),
             "lr": optimizer_lr(optimizer, new_step),
         }
+        if guarded:
+            metrics.update(guard_mod.guard_metrics(new_guard))
         for k, v in comm.items():
-            metrics[f"comm/{k}"] = jax.lax.pmean(v, axis_name)
+            # guard/* stats are already-global diagnostics, not comm volumes
+            metrics[k if k.startswith("guard/") else f"comm/{k}"] = (
+                jax.lax.pmean(v, axis_name))
 
         new_state = dataclasses.replace(
             state,
@@ -167,12 +229,13 @@ def make_train_step(
             opt_state=new_opt,
             ef=new_ef,
             comp=new_comp,
+            guard=new_guard,
         )
         return new_state, metrics
 
     state_spec = TrainState(
         step=P(), params=P(), batch_stats=P(), opt_state=P(), ef=P(axis_name),
-        rng=P(), comp=P(axis_name),
+        rng=P(), comp=P(axis_name), guard=P(),
     )
     sharded = shard_map(
         local_step,
@@ -191,6 +254,10 @@ def make_train_step(
             raise ValueError(
                 "error_feedback=True but state.ef is empty; build it with "
                 f"init_ef_state(params, cfg, num_devices={n_dev})")
+        if guarded and state.guard == ():
+            raise ValueError(
+                "guard_cfg set but state.guard is empty; build it with "
+                "init_guard_state(guard_cfg)")
         for field, hint in (("ef", "init_ef_state(params, cfg"),
                             ("comp", "init_comp_state(params, cfg")):
             for leaf in jax.tree.leaves(getattr(state, field)):
@@ -244,7 +311,7 @@ def make_eval_step(apply_fn: ApplyFn, mesh: Mesh, *, axis_name: str = "data"):
 
     state_spec = TrainState(
         step=P(), params=P(), batch_stats=P(), opt_state=P(), ef=P(axis_name),
-        rng=P(), comp=P(axis_name),
+        rng=P(), comp=P(axis_name), guard=P(),
     )
     sharded = shard_map(
         local_eval,

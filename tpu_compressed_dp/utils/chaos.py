@@ -1,0 +1,168 @@
+"""Deterministic fault injection ("chaos") for the robustness stack.
+
+Two injection planes, both driven by the *step counter* rather than wall
+clock or RNG, so a replay after checkpoint restore reproduces the exact same
+faults (resume-safe by construction — the property the chaos drill's
+crash-recovery case depends on):
+
+  * **in-graph** (:func:`inject`, traced into the jitted step): overwrite a
+    chosen worker's gradients or loss with NaN/Inf at chosen steps.  This is
+    the adversary the step guard (:mod:`tpu_compressed_dp.train.guard`) must
+    beat: one poisoned worker, everyone must skip identically and the
+    EF/compressor state must stay clean.
+  * **host-side** (:class:`CrashInjector`): raise :class:`ChaosCrash` out of
+    the training loop at a chosen global step, exercising
+    ``run_with_recovery``'s restore-and-replay path.  Fires once per
+    process (a restored replay walking back through the crash step must not
+    re-crash, or recovery could never make progress).
+
+CLI surface: every harness takes ``--chaos SPEC`` where SPEC is
+comma-separated ``key=value`` tokens (a bare ``nan``/``inf`` sets ``kind``):
+
+    --chaos "nan,target=grads,steps=3+7,worker=1"
+    --chaos "inf,target=loss,every=50"
+    --chaos "crash=120"                  # host crash only, no in-graph fault
+
+``tools/chaos_drill.py`` runs the full injection matrix and asserts the
+guard's invariants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["ChaosConfig", "ChaosCrash", "CrashInjector", "fires_at", "inject"]
+
+
+class ChaosCrash(RuntimeError):
+    """The injected host-side failure (plays the role of a preempted VM or a
+    killed worker; anything but KeyboardInterrupt/SystemExit, which
+    ``run_with_recovery`` deliberately re-raises)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """One fault-injection scenario.
+
+    kind:           'nan' | 'inf' — the poison value
+    target:         'grads' (every element of the worker's local gradient) |
+                    'loss' (the worker's scalar loss)
+    steps:          global step indices (0-based, pre-increment — the value
+                    of ``TrainState.step`` going *into* the step) at which
+                    the in-graph fault fires
+    every:          also fire whenever ``step % every == 0`` (0 = off)
+    worker:         linearised data-parallel worker index to poison (over
+                    (data,) or (data, seq) — see ``guard.worker_index``)
+    crash_at_step:  host-side: raise :class:`ChaosCrash` before dispatching
+                    this global step (-1 = off); fires once per process
+    """
+
+    kind: str = "nan"
+    target: str = "grads"
+    steps: Tuple[int, ...] = ()
+    every: int = 0
+    worker: int = 0
+    crash_at_step: int = -1
+
+    def __post_init__(self):
+        if self.kind not in ("nan", "inf"):
+            raise ValueError(f"chaos kind must be nan|inf, got {self.kind!r}")
+        if self.target not in ("grads", "loss"):
+            raise ValueError(
+                f"chaos target must be grads|loss, got {self.target!r}")
+        if self.every < 0 or self.worker < 0:
+            raise ValueError("chaos every/worker must be >= 0")
+
+    @property
+    def injects_in_graph(self) -> bool:
+        return bool(self.steps) or self.every > 0
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosConfig":
+        """Parse the ``--chaos`` CLI string (see module docstring)."""
+        kw: dict = {}
+        for tok in filter(None, (t.strip() for t in spec.split(","))):
+            if "=" not in tok:
+                if tok not in ("nan", "inf"):
+                    raise ValueError(
+                        f"bad --chaos token {tok!r}: bare tokens must be "
+                        "nan|inf; everything else is key=value")
+                kw["kind"] = tok
+                continue
+            k, v = tok.split("=", 1)
+            k = k.strip()
+            v = v.strip()
+            if k in ("kind", "target"):
+                kw[k] = v
+            elif k == "steps":
+                kw["steps"] = tuple(int(s) for s in v.split("+") if s)
+            elif k in ("every", "worker"):
+                kw[k] = int(v)
+            elif k in ("crash", "crash_at_step"):
+                kw["crash_at_step"] = int(v)
+            else:
+                raise ValueError(
+                    f"unknown --chaos key {k!r} (kind|target|steps|every|"
+                    "worker|crash)")
+        return cls(**kw)
+
+
+def fires_at(chaos: ChaosConfig, step: Array) -> Array:
+    """Traced predicate: does the in-graph fault fire at ``step``?  Pure
+    function of the step counter — replay-deterministic."""
+    fire = jnp.asarray(False)
+    for s in chaos.steps:
+        fire = fire | (step == s)
+    if chaos.every > 0:
+        fire = fire | (step % chaos.every == 0)
+    return fire
+
+
+def inject(chaos: ChaosConfig, step: Array, widx: Array, loss: Array,
+           grads: Any) -> Tuple[Array, Any]:
+    """Poison ``loss`` or ``grads`` on the targeted worker at firing steps
+    (identity everywhere else).  Runs inside the jitted step, *before* the
+    guard's finiteness vote."""
+    fire = fires_at(chaos, step) & (widx == chaos.worker)
+    bad = float("nan") if chaos.kind == "nan" else float("inf")
+    if chaos.target == "loss":
+        loss = jnp.where(fire, jnp.asarray(bad, loss.dtype), loss)
+    else:
+        grads = jax.tree.map(
+            lambda g: jnp.where(fire, jnp.asarray(bad, g.dtype), g), grads)
+    return loss, grads
+
+
+class CrashInjector:
+    """Host-side crash at a global step, once per process.
+
+    >>> crash = CrashInjector(chaos.crash_at_step)
+    >>> crash.check(global_step)   # raises ChaosCrash at/after the step
+    """
+
+    def __init__(self, crash_at_step: int):
+        self.crash_at_step = int(crash_at_step)
+        self.fired = False
+
+    def check(self, step: int) -> None:
+        # >= not ==: epoch-granular callers (the CNN harnesses check once
+        # per batch with the attempted-step counter) must not miss the mark
+        # when a skip/resume lands the counter past it
+        if (not self.fired and self.crash_at_step >= 0
+                and int(step) >= self.crash_at_step):
+            self.fired = True
+            raise ChaosCrash(
+                f"chaos: injected host crash at step {int(step)}")
+
+
+def maybe_crash_injector(chaos: Optional[ChaosConfig]) -> Optional[CrashInjector]:
+    """Convenience for the harnesses: an armed injector, or None."""
+    if chaos is None or chaos.crash_at_step < 0:
+        return None
+    return CrashInjector(chaos.crash_at_step)

@@ -94,27 +94,45 @@ class Checkpointer:
                     meta=ocp.args.JsonRestore(),
                 ),
             )
-        except ValueError as e:
-            # Checkpoints written before stateful compressors have no 'comp'
-            # entry, and Orbax rejects a template with keys the saved tree
-            # lacks — retry without it (_from_saveable then keeps the
-            # caller's comp).  No error-message sniffing: Orbax also rejects
-            # templates MISSING a saved key, so the comp-less retry can only
-            # succeed when the save genuinely predates 'comp'; for any other
-            # mismatch (shape/rank changes, renamed keys) the retry fails
-            # too and the ORIGINAL error propagates.
+        except (ValueError, KeyError) as e:
+            # The template can legitimately disagree with the saved tree on
+            # the OPTIONAL state entries: legacy checkpoints lack 'comp'
+            # (pre-PowerSGD) and/or 'guard' (pre-step-guard) entirely, and
+            # toggling powersgd / --guard between save and resume flips
+            # those entries between the empty marker {} and {'on': ...}
+            # (Orbax raises ValueError for template-missing-saved-key and
+            # KeyError for saved-missing-template-key).  Fall back to ONE
+            # template-free restore (saved structure as-is) and let
+            # _from_saveable reconcile guard/comp against the target — but
+            # first verify every OTHER entry matches the template's
+            # structure/shape/dtype exactly, so a genuine mismatch (resized
+            # params, renamed keys) still surfaces as the ORIGINAL error
+            # instead of silently restoring garbage into the caller's tree.
             try:
                 payload = self.manager.restore(
                     step,
                     args=ocp.args.Composite(
-                        state=ocp.args.StandardRestore(
-                            {k: v for k, v in template.items()
-                             if k != "comp"}),
+                        state=ocp.args.StandardRestore(),
                         meta=ocp.args.JsonRestore(),
                     ),
                 )
-            except ValueError:
+            except Exception:
                 raise e
+            saved = payload["state"]
+            if set(saved) - set(template):
+                raise e  # fields this build does not know — not our legacy case
+            for k, tv in template.items():
+                if k in ("guard", "comp"):
+                    continue
+                if k not in saved:
+                    raise e
+                if (jax.tree.structure(tv) != jax.tree.structure(saved[k])):
+                    raise e
+                for tl, sl in zip(jax.tree.leaves(tv),
+                                  jax.tree.leaves(saved[k])):
+                    if (tuple(np.shape(tl)) != tuple(np.shape(sl))
+                            or np.asarray(tl).dtype != np.asarray(sl).dtype):
+                        raise e
         state = _from_saveable(target_state, payload["state"])
         meta = dict(payload.get("meta") or {})
         if "best_metric" in meta:
@@ -126,27 +144,40 @@ class Checkpointer:
 
 
 def _to_saveable(state: TrainState) -> Dict[str, Any]:
+    from tpu_compressed_dp.train.guard import guard_to_dict
+
     d = {f.name: getattr(state, f.name) for f in dataclasses.fields(state)}
     # PRNG keys: store raw key data (typed keys are not serialisable)
     d["rng"] = jax.random.key_data(d["rng"])
-    # ef/comp == () when off; Orbax cannot round-trip an empty container leaf
+    # ef/comp/guard == () when off; Orbax cannot round-trip an empty
+    # container leaf.  GuardState serialises as a plain dict so the on-disk
+    # form needs no pytree registration agreement with a future reader.
     d["ef"] = {"on": d["ef"]} if d["ef"] != () else {}
     d["comp"] = {"on": d["comp"]} if d["comp"] != () else {}
+    d["guard"] = {"on": guard_to_dict(d["guard"])} if d["guard"] != () else {}
     return d
 
 
 def _from_saveable(target: TrainState, d: Dict[str, Any]) -> TrainState:
+    from tpu_compressed_dp.train.guard import guard_from_dict
+
     d = dict(d)
     d["rng"] = jax.random.wrap_key_data(np.asarray(d["rng"]))
     ef = d["ef"]
     d["ef"] = ef["on"] if "on" in ef else ()
-    if "comp" in d:
-        d["comp"] = d["comp"]["on"] if "on" in d["comp"] else ()
+    # comp/guard: a saved value wins; the empty marker {} (feature was OFF
+    # at save time) or a missing key (checkpoint predates the field) keeps
+    # the CALLER's value — a freshly-built warm start / init_guard_state
+    # when resuming an old run with powersgd / the guard newly enabled,
+    # () otherwise — instead of clobbering it.
+    if "comp" in d and "on" in d["comp"]:
+        d["comp"] = d["comp"]["on"]
     else:
-        # checkpoint written before stateful compressors: keep the caller's
-        # comp (a freshly-built warm start when resuming an old run with
-        # powersgd newly enabled; () otherwise) instead of clobbering it
         d["comp"] = target.comp
+    if "guard" in d and "on" in d["guard"]:
+        d["guard"] = guard_from_dict(d["guard"]["on"])
+    else:
+        d["guard"] = target.guard
     return dataclasses.replace(target, **d)
 
 

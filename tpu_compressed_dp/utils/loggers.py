@@ -191,11 +191,16 @@ class MetricAccumulator:
     def __init__(self):
         self.sums: Dict[str, float] = {}
         self.weights: Dict[str, float] = {}
+        #: most recent value per key — for cumulative/stateful metrics
+        #: (guard/skipped totals, guard/loss_scale) where a weighted mean is
+        #: meaningless and the end-of-epoch value is the honest summary
+        self.last: Dict[str, float] = {}
 
     def update(self, metrics: Dict[str, float]) -> None:
         w = float(metrics.get("count", 1.0))
         for k, v in metrics.items():
             v = float(v)
+            self.last[k] = v
             if k in self.SUM_KEYS:
                 self.sums[k] = self.sums.get(k, 0.0) + v
             else:

@@ -20,8 +20,8 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional, Tuple
 
-__all__ = ["TimeMeter", "NetworkMeter", "CommMeter", "network_bytes",
-           "per_chip_traffic_bytes"]
+__all__ = ["TimeMeter", "NetworkMeter", "CommMeter", "GuardMeter",
+           "network_bytes", "per_chip_traffic_bytes"]
 
 
 def per_chip_traffic_bytes(psum_bytes: float, allgather_bytes: float,
@@ -114,6 +114,48 @@ class NetworkMeter:
         tg = (transmit - self.last_transmit) * 8 / 1e9 / dt
         self.last_t, self.last_recv, self.last_transmit = now, recv, transmit
         return rg, tg
+
+
+class GuardMeter:
+    """Step-guard bookkeeping from the train step's ``guard/*`` metrics
+    (:mod:`tpu_compressed_dp.train.guard`).
+
+    ``update(metrics, step)`` takes a fetched metrics dict at global step
+    ``step`` — any cadence works, because the skip rate comes from the
+    DELTA of the cumulative ``guard/skipped`` counter over the step delta,
+    not from sampling per-step verdicts (sampling at the log cadence
+    aliases against periodic faults: a 10% skip rate observed every 10th
+    step reads as 0% or 100%).  ``summary`` reports the latest guard
+    scalars plus ``guard/skip_rate`` over the window since the previous
+    update.  No-ops (empty summary) when the guard is off.
+    """
+
+    def __init__(self):
+        self.last: Dict[str, float] = {}
+        self._prev_skipped = 0.0
+        self._prev_step = 0.0
+        self._seeded = False
+        self._rate = 0.0
+
+    def update(self, metrics: Dict[str, float], step: float) -> None:
+        if "guard/skipped" not in metrics:
+            return
+        self.last = {k: float(v) for k, v in metrics.items()
+                     if k.startswith("guard/")}
+        cur = float(metrics["guard/skipped"])
+        if self._seeded and step > self._prev_step:
+            self._rate = (cur - self._prev_skipped) / (step - self._prev_step)
+        # the first observation only SEEDS the window: on a resumed run the
+        # restored cumulative counter and step are both nonzero, and rating
+        # them against (0, 0) would bill every historical skip to a window
+        # that saw none
+        self._prev_skipped, self._prev_step = cur, float(step)
+        self._seeded = True
+
+    def summary(self) -> Dict[str, float]:
+        if not self.last:
+            return {}
+        return {**self.last, "guard/skip_rate": self._rate}
 
 
 class CommMeter:

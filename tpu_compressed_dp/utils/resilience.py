@@ -41,24 +41,41 @@ class Heartbeat:
         self.interval_s = interval_s
         self.payload = dict(payload or {})
         self._step = 0
+        # update() runs on the train loop thread while _write() iterates the
+        # payload on the writer thread: unsynchronised, json.dump raises
+        # "dict changed size during iteration" intermittently (and the
+        # writer thread died silently, turning a live worker into a
+        # stale-heartbeat false positive).  The lock guards the mutation;
+        # _write snapshots under it and serialises/writes outside it, so
+        # the train loop never blocks on disk.
+        self._lock = threading.Lock()
+        #: first exception the writer thread hit (None = healthy); surfaced
+        #: rather than swallowed so tests and watchdog wrappers can assert
+        self.last_error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._write()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def update(self, step: int, **payload) -> None:
-        self._step = int(step)
-        self.payload.update(payload)
+        with self._lock:
+            self._step = int(step)
+            self.payload.update(payload)
 
     def _write(self) -> None:
+        with self._lock:
+            rec = {"ts": time.time(), "step": self._step, **self.payload}
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"ts": time.time(), "step": self._step, **self.payload}, f)
+            json.dump(rec, f)
         os.replace(tmp, self.path)  # atomic: readers never see partial JSON
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
-            self._write()
+            try:
+                self._write()
+            except Exception as e:  # e.g. disk full: record, keep beating
+                self.last_error = e
 
     def stop(self) -> None:
         self._stop.set()
@@ -108,11 +125,18 @@ def run_with_recovery(
             epoch += 1
         except (KeyboardInterrupt, SystemExit):
             raise
-        except Exception:
+        except Exception as train_err:
             failures += 1
             if checkpointer is None or failures > max_retries:
                 raise
-            state, meta = checkpointer.restore(state)
+            try:
+                state, meta = checkpointer.restore(state)
+            except FileNotFoundError:
+                # crashed before the FIRST checkpoint existed: there is
+                # nothing to replay from, and letting the restore's
+                # FileNotFoundError propagate would mask the actual
+                # training failure the operator needs to see
+                raise train_err
             if on_restore is not None:
                 state = on_restore(state)
             restores += 1
