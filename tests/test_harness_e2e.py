@@ -1,6 +1,8 @@
 """End-to-end smoke tests of the dawn harness (`--short-epoch` analog,
 SURVEY.md §4): synthetic data, few epochs, assert learning happens."""
 
+import json
+
 import numpy as np
 import pytest
 
@@ -29,12 +31,58 @@ def test_dense_resnet9_learns(tmp_path, mesh8):
 
 
 def test_compressed_topk_layerwise_learns(tmp_path, mesh8):
+    """Top-K + EF learns; the run doubles as the dawn telemetry e2e: the
+    guard rides along (fp32 identity scale — updates are bitwise the
+    unguarded run's), and the JSONL event stream + Prometheus textfile +
+    heartbeat telemetry must come out parseable and complete."""
+    ev_path = str(tmp_path / "events.jsonl")
+    hb_path = str(tmp_path / "hb.json")
     summary = run_dawn(
         tmp_path, epochs=3, compress="layerwise", method="Topk", ratio=0.1,
-        error_feedback=True, momentum=0.9,
+        error_feedback=True, momentum=0.9, guard=True,
+        events=ev_path, prom=str(tmp_path / "metrics.prom"),
+        heartbeat=hb_path,
     )
     assert summary["train acc"] > 0.5
     assert 0.0 < summary["sent frac"] < 0.2  # ~10% of elements sent
+    assert summary["img/s"] > 0 and summary["comm MB/s"] > 0
+
+    # event stream: schema-versioned, carries step metrics + guard counters
+    from tpu_compressed_dp.obs import export as obs_export
+
+    events = obs_export.read_events(ev_path)
+    assert [e["kind"] for e in events][:1] == ["run_start"]
+    assert events[-1]["kind"] == "run_end"
+    epochs_rec = [e for e in events if e["kind"] == "epoch"]
+    assert len(epochs_rec) == 3
+    for e in epochs_rec:
+        assert e["v"] == obs_export.SCHEMA_VERSION
+        assert "train loss" in e["metrics"] and "img/s" in e["metrics"]
+        assert e["comm"]["comm/sent_bits"] > 0
+        assert e["guard"]["guard/skipped"] == 0.0  # armed, no faults
+        assert e["timeline"]["time/steps_per_sec"] > 0
+        assert e["step_spans"]
+
+    # trace_report renders breakdown + throughput from the stream
+    import tools.trace_report as tr
+
+    report = tr.render_report(events)
+    assert "per-phase step-time breakdown" in report
+    assert "dispatch" in report and "MFU" in report
+
+    # prometheus textfile: typed, declared metrics present
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert "# TYPE tcdp_comm_sent_bits gauge" in prom
+    assert "tcdp_time_steps_per_sec" in prom
+
+    # heartbeat carries the telemetry snapshot the watchdog consumes
+    import tools.watchdog as wd
+
+    rec = json.loads((tmp_path / "hb.json").read_text())
+    assert rec["telemetry"]["steps_per_sec"] > 0
+    assert rec["telemetry"]["step_p95_ms"] > 0
+    assert wd.main(["--check", "--heartbeat", hb_path,
+                    "--max_age", "300", "--max_wedge", "10"]) == 0
 
 
 def test_compressed_entiremodel_qsgd(tmp_path, mesh8):

@@ -53,20 +53,39 @@ class TestByteCorpus:
 
 def test_lm_harness_e2e(tmp_path):
     """dp2 x sp2 x tp2 pretrain: converges below the uniform floor, reports
-    the compression fraction, checkpoints, and resumes."""
+    the compression fraction + throughput telemetry, checkpoints, resumes,
+    and emits a parseable JSONL event stream."""
     from tpu_compressed_dp.harness import lm
 
+    ev_path = str(tmp_path / "events.jsonl")
     argv = [
         "--preset", "tiny", "--dp", "2", "--sp", "2", "--tp", "2",
         "--steps", "24", "--seq_len", "64", "--global_batch", "8", "--fp32",
         "--compress", "entiremodel", "--method", "topk", "--ratio", "0.01",
-        "--error_feedback", "--log_every", "8",
+        "--error_feedback", "--log_every", "8", "--events", ev_path,
         "--checkpoint_dir", str(tmp_path / "ck"),
     ]
     s = lm.main(argv)
     assert s["step"] == 24
     assert s["loss"] < math.log(256)
     assert s["sent frac"] == pytest.approx(0.01, rel=0.05)
+    assert s["tok/s"] > 0 and s["comm MB/s"] > 0
+
+    # per-log-window step events: schema version, step metrics, timeline;
+    # trace_report renders the breakdown/throughput without error
+    import tools.trace_report as tr
+    from tpu_compressed_dp.obs import export as obs_export
+
+    events = obs_export.read_events(ev_path)
+    steps_rec = [e for e in events if e["kind"] == "step"]
+    assert len(steps_rec) == 3  # log_every=8 over 24 steps
+    assert all(e["v"] == obs_export.SCHEMA_VERSION for e in events)
+    assert steps_rec[-1]["metrics"]["loss"] == pytest.approx(s["loss"])
+    assert steps_rec[-1]["throughput"]["throughput/tokens_per_sec"] > 0
+    assert steps_rec[-1]["comm"]["comm/sent_bits"] > 0
+    report = tr.render_report(events)
+    assert "per-phase step-time breakdown" in report
+    assert "tok/s" in report or "rate" in report
 
     s2 = lm.main(argv[:-2] + ["--resume", str(tmp_path / "ck"), "--steps", "26"])
     assert s2["step"] == 26

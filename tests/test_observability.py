@@ -1,16 +1,432 @@
-"""Observability: tensorboard/file loggers, meters, profiler wiring."""
+"""Observability: metric registry conformance, step timeline, event stream,
+Prometheus export, watchdog, loggers, meters, profiler wiring."""
 
+import itertools
 import json
 import os
 
 import numpy as np
 import pytest
 
+from tpu_compressed_dp.obs import export as obs_export
+from tpu_compressed_dp.obs import registry as obs_registry
+from tpu_compressed_dp.obs.trace import StepTimeline
 from tpu_compressed_dp.utils import meters
 from tpu_compressed_dp.utils.loggers import FileLogger, NoOp, TensorboardLogger
 
 
+@pytest.mark.quick
+class TestMetricRegistry:
+    def test_every_spec_is_wellformed(self):
+        for name, ms in obs_registry.REGISTRY.items():
+            assert ms.name == name
+            assert ms.kind in ("counter", "gauge", "timing")
+            assert ms.reduction in ("mean", "sum", "min", "max")
+            assert ms.emitter in ("engine", "step", "eval", "host")
 
+    def test_canonical_maps_engine_keys(self):
+        assert obs_registry.canonical("sent_bits") == "comm/sent_bits"
+        assert obs_registry.canonical("comm/sent_bits") == "comm/sent_bits"
+        assert obs_registry.canonical("guard/nonfinite") == "guard/nonfinite"
+        assert obs_registry.is_declared("sync_agree")
+        assert not obs_registry.is_declared("made_up_key")
+        assert obs_registry.undeclared(["sent_bits", "nope"]) == ["nope"]
+
+    def test_redeclare_conflict_rejected(self):
+        with pytest.raises(ValueError, match="already declared"):
+            obs_registry.declare("loss", "counter", "nats", "sum", "step")
+        # identical redeclaration is a no-op
+        ms = obs_registry.REGISTRY["loss"]
+        obs_registry.declare(ms.name, ms.kind, ms.unit, ms.reduction,
+                             ms.emitter, ms.help)
+
+    def test_prometheus_name_sanitised(self):
+        assert obs_registry.prometheus_name("sent_bits") == \
+            "tcdp_comm_sent_bits"
+        assert obs_registry.prometheus_name("time/step_p95_ms") == \
+            "tcdp_time_step_p95_ms"
+
+    def test_diag_table_derived_from_registry(self):
+        """The partitioned engine's diagnostic-reduction table is BUILT from
+        the registry declarations — min -> pmin, max -> pmax."""
+        import jax
+
+        from tpu_compressed_dp.parallel import dp
+
+        diags = obs_registry.engine_diag_reductions()
+        assert diags == {"sync_agree": "min", "guard/nonfinite": "max"}
+        assert set(dp._DIAG_STATS) == set(diags)
+        assert dp._DIAG_STATS["sync_agree"][0] is jax.lax.pmin
+        assert dp._DIAG_STATS["guard/nonfinite"][0] is jax.lax.pmax
+
+    def test_accumulator_sum_keys_derived(self):
+        from tpu_compressed_dp.utils.loggers import MetricAccumulator
+
+        assert "correct" in MetricAccumulator.SUM_KEYS
+        assert "loss_sum" in MetricAccumulator.SUM_KEYS
+        assert "loss" not in MetricAccumulator.SUM_KEYS
+
+
+CONFORMANCE_METHODS = [None, "topk", "blocktopk", "randomk", "thresholdv",
+                       "adaptive_threshold", "terngrad", "qsgd", "powersgd"]
+
+
+def _sync_stat_keys(cfg, mesh):
+    """Trace one sync under shard_map (no compile/run: eval_shape) and
+    return the stats keys it emits."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_compressed_dp.compat import shard_map
+    from tpu_compressed_dp.parallel.dp import init_comp_state, make_grad_sync
+
+    grads = {"w": jnp.zeros((64, 8)), "b": jnp.zeros((8,))}
+    sync = make_grad_sync(cfg)
+    ef = (jax.tree.map(lambda g: jnp.zeros(g.shape, jnp.float32), grads)
+          if cfg.error_feedback else ())
+    comp = init_comp_state(grads, cfg)
+
+    def f(g, e, c, k):
+        # always guard-gated: covers the guard/nonfinite key; the ungated
+        # path emits a strict subset
+        return sync(g, e, c, k, ok=jnp.asarray(True))[3]
+
+    sm = shard_map(f, mesh=mesh, in_specs=(P(), P(), P(), P()),
+                   out_specs=P())
+    out = jax.eval_shape(sm, grads, ef, comp, jax.random.key(0))
+    return set(out.keys())
+
+
+class TestRegistryConformance:
+    """Every stats key either sync engine can emit — across the FULL
+    method x mode x transport x granularity matrix — must be declared in
+    the metric registry.  Pure tracing (eval_shape), no compile: the whole
+    matrix costs seconds, so tier-1 exercises all of it."""
+
+    def test_all_methods_transports_granularities(self, mesh8):
+        from tpu_compressed_dp.parallel.dp import CompressionConfig
+        from tpu_compressed_dp.parallel.mesh import make_data_mesh
+
+        mesh = make_data_mesh(4)
+        failures = []
+        seen = set()
+        for m, mode, transport, gran in itertools.product(
+                CONFORMANCE_METHODS, ("simulate", "wire"),
+                ("allgather", "sharded"),
+                ("layerwise", "entiremodel", "bucketed")):
+            # EF composes with everything except the unbiased quantizers
+            # (wire mode rejects that combination at build time)
+            ef = m not in (None, "terngrad", "qsgd")
+            cfg = CompressionConfig(
+                method=m, granularity=gran, mode=mode, transport=transport,
+                ratio=0.25, error_feedback=ef, check_sync=True)
+            keys = _sync_stat_keys(cfg, mesh)
+            seen |= keys
+            bad = obs_registry.undeclared(keys)
+            if bad:
+                failures.append((m, mode, transport, gran, bad))
+        assert not failures, f"undeclared stats keys: {failures}"
+        # the matrix actually exercised the interesting keys (a silently
+        # empty sweep would vacuously pass)
+        for expected in ("sent_bits_psum", "sent_bits_alltoall",
+                         "shard_overflow", "threshold_overflow",
+                         "sync_agree", "guard/nonfinite"):
+            assert expected in seen, f"matrix never emitted {expected}"
+
+    def test_step_metric_keys_declared(self):
+        """The step factories' own metric names (loss/correct/count/lr/
+        tokens + guard/*) are declared too."""
+        from tpu_compressed_dp.train.guard import (GuardConfig,
+                                                   guard_metrics,
+                                                   init_guard_state)
+
+        gm = guard_metrics(init_guard_state(GuardConfig()))
+        step_keys = {"loss", "correct", "count", "lr", "tokens",
+                     "loss_sum", "correct5", *gm}
+        assert obs_registry.undeclared(step_keys) == []
+
+
+@pytest.mark.quick
+class TestStepTimeline:
+    def _clock(self):
+        class C:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        return C()
+
+    def test_splits_and_percentiles(self):
+        clk = self._clock()
+        tl = StepTimeline(capacity=8, clock=clk, sync=lambda: None)
+        for i in range(4):
+            clk.t += 0.25          # data wait
+            tl.batch_ready()
+            clk.t += 0.75          # dispatch
+            tl.step_dispatched()
+        p = tl.percentiles()
+        assert p["p50"] == pytest.approx(1.0)
+        assert p["p95"] == pytest.approx(1.0)
+        assert tl.data_wait_frac() == pytest.approx(0.25)
+        assert tl.steps_per_sec() == pytest.approx(1.0)
+        snap = tl.snapshot()
+        assert snap["time/step_p95_ms"] == pytest.approx(1000.0)
+        assert snap["time/data_wait_frac"] == pytest.approx(0.25)
+
+    def test_ring_bounds_memory_and_drain(self):
+        clk = self._clock()
+        tl = StepTimeline(capacity=4, clock=clk, sync=lambda: None)
+        for _ in range(10):
+            clk.t += 1.0
+            tl.batch_ready()
+            clk.t += 1.0
+            tl.step_dispatched()
+        assert len(tl.records) == 4      # ring: most recent only
+        assert tl.steps == 10
+        drained = tl.drain()
+        assert len(drained) <= 4         # pending is capacity-bounded too
+        assert tl.drain() == []          # drained once
+        assert {"t0", "data", "dispatch", "total"} <= set(drained[0])
+
+    def test_resume_excludes_between_step_work(self):
+        """Blocking between-step work (eval, checkpoint saves, a log-window
+        device_get drain) must not be billed as the next step's data wait."""
+        clk = self._clock()
+        tl = StepTimeline(capacity=8, clock=clk, sync=lambda: None)
+        clk.t += 0.1
+        tl.batch_ready()
+        clk.t += 0.9
+        tl.step_dispatched()
+        clk.t += 100.0          # epoch-end eval + checkpoint
+        tl.resume()
+        clk.t += 0.1
+        tl.batch_ready()
+        clk.t += 0.9
+        tl.step_dispatched()
+        recs = list(tl.records)
+        assert recs[1]["data"] == pytest.approx(0.1)
+        assert recs[1]["total"] == pytest.approx(1.0)
+        assert tl.data_wait_frac() == pytest.approx(0.1)
+
+    def test_device_sync_sampling(self):
+        clk = self._clock()
+        synced = []
+
+        def sync():
+            synced.append(clk.t)
+            clk.t += 0.5     # the drain the sample measures
+
+        tl = StepTimeline(capacity=8, device_sync_every=2, clock=clk,
+                          sync=sync)
+        for _ in range(4):
+            clk.t += 0.1
+            tl.batch_ready()
+            clk.t += 0.1
+            tl.step_dispatched()
+        assert len(synced) == 2          # steps 2 and 4
+        recs = list(tl.records)
+        assert "device" not in recs[0] and "device" in recs[1]
+        assert recs[1]["device"] == pytest.approx(0.5)
+        assert recs[1]["total"] == pytest.approx(0.7)
+
+
+@pytest.mark.quick
+class TestTimerRegression:
+    def test_constant_memory_and_split_semantics(self, monkeypatch):
+        """utils/timer.Timer kept every split timestamp forever (unbounded
+        on long runs); it must keep only the last one, with identical
+        split/total semantics."""
+        from tpu_compressed_dp.utils import timer as timer_mod
+
+        t = {"now": 100.0}
+        monkeypatch.setattr(timer_mod.time, "time", lambda: t["now"])
+        tm = timer_mod.Timer()
+        assert not hasattr(tm, "times")   # the unbounded list is gone
+        t["now"] = 101.5
+        assert tm(include_in_total=True) == pytest.approx(1.5)
+        t["now"] = 102.0
+        assert tm(include_in_total=False) == pytest.approx(0.5)
+        t["now"] = 104.0
+        assert tm() == pytest.approx(2.0)
+        assert tm.total_time == pytest.approx(3.5)  # excluded split stays out
+        # a long run's split count leaves no growing state behind
+        for _ in range(1000):
+            t["now"] += 0.001
+            tm()
+        assert isinstance(tm.last_time, float)
+
+
+@pytest.mark.quick
+class TestEventStreamAndPrometheus:
+    def test_stream_schema_and_roundtrip(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with obs_export.EventStream(p, meta={"harness": "t"}) as es:
+            es.emit("step", step=1, metrics={"loss": 1.0})
+        events = obs_export.read_events(p)
+        assert [e["kind"] for e in events] == ["run_start", "step", "run_end"]
+        assert all(e["v"] == obs_export.SCHEMA_VERSION for e in events)
+        assert all("ts" in e for e in events)
+        assert events[0]["harness"] == "t"
+        assert events[1]["metrics"] == {"loss": 1.0}
+        # append-only: a resumed run extends the same file
+        with obs_export.EventStream(p) as es:
+            es.emit("step", step=2)
+        assert len(obs_export.read_events(p)) == 6
+
+    def test_prometheus_textfile(self, tmp_path):
+        p = str(tmp_path / "m.prom")
+        obs_export.write_prometheus(
+            {"comm/sent_bits": 1.5e6, "made/up": 2.0, "skipme": "str"},
+            p, labels={"harness": "dawn"})
+        body = open(p).read()
+        # everything exposes as gauge: the harnesses write per-window
+        # aggregates, not running totals — a counter TYPE would make
+        # Prometheus rate() treat every dip as a reset
+        assert "# TYPE tcdp_comm_sent_bits gauge" in body
+        assert '# HELP tcdp_comm_sent_bits' in body
+        assert 'tcdp_comm_sent_bits{harness="dawn"} 1.5e+06' in body
+        assert "# TYPE tcdp_made_up gauge" in body
+        assert "skipme" not in body
+
+    def test_telemetry_snapshot(self):
+        clk_t = [0.0]
+
+        class TL(StepTimeline):
+            pass
+
+        tl = StepTimeline(clock=lambda: clk_t[0], sync=lambda: None)
+        clk_t[0] = 1.0
+        tl.batch_ready()
+        clk_t[0] = 2.0
+        tl.step_dispatched()
+        snap = obs_export.telemetry_snapshot(tl, step=7, last_good_step=5)
+        assert snap["step"] == 7 and snap["last_good_step"] == 5
+        assert snap["steps_per_sec"] == pytest.approx(0.5)
+        assert snap["step_p95_ms"] == pytest.approx(2000.0)
+
+
+@pytest.mark.quick
+class TestWatchdog:
+    def _hb(self, tmp_path, **kw):
+        import time as _time
+
+        p = str(tmp_path / "hb.json")
+        rec = {"ts": _time.time(), "step": 100, "last_good_step": 100}
+        rec.update(kw)
+        json.dump(rec, open(p, "w"))
+        return p
+
+    def test_healthy(self, tmp_path):
+        from tpu_compressed_dp.utils.resilience import check_heartbeat
+
+        p = self._hb(tmp_path, telemetry={"steps_per_sec": 2.0})
+        assert check_heartbeat(p, max_age_s=60, max_wedge_steps=10,
+                               min_steps_per_sec=0.1) == []
+
+    def test_stale_wedged_stalled_missing(self, tmp_path):
+        import time as _time
+
+        from tpu_compressed_dp.utils.resilience import check_heartbeat
+
+        p = self._hb(tmp_path, ts=_time.time() - 999, last_good_step=10,
+                     telemetry={"steps_per_sec": 0.001})
+        probs = check_heartbeat(p, max_age_s=60, max_wedge_steps=50,
+                                min_steps_per_sec=0.1)
+        assert len(probs) == 3
+        assert any("stale" in x for x in probs)
+        assert any("wedged" in x for x in probs)
+        assert any("stalled" in x for x in probs)
+        missing = check_heartbeat(str(tmp_path / "no.json"))
+        assert missing and "missing" in missing[0]
+        # absent optional fields skip their checks, not fail them
+        q = str(tmp_path / "hb2.json")
+        json.dump({"ts": _time.time(), "step": 5}, open(q, "w"))
+        assert check_heartbeat(q, max_age_s=60, max_wedge_steps=1,
+                               min_steps_per_sec=1.0) == []
+
+    def test_cli_exit_codes(self, tmp_path):
+        import time as _time
+
+        import tools.watchdog as wd
+
+        p = self._hb(tmp_path)
+        assert wd.main(["--check", "--heartbeat", p]) == 0
+        json.dump({"ts": _time.time() - 999, "step": 1}, open(p, "w"))
+        assert wd.main(["--check", "--heartbeat", p]) == 1
+        assert wd.main(["--check", "--heartbeat",
+                        str(tmp_path / "no.json")]) == 2
+
+
+@pytest.mark.quick
+class TestTraceReport:
+    def _events(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with obs_export.EventStream(p, meta={"harness": "dawn"}) as es:
+            spans = [{"t0": 10.0 + i, "data": 0.2, "dispatch": 0.8,
+                      "total": 1.0} for i in range(4)]
+            spans[1]["device"] = 0.5
+            es.emit("epoch", epoch=1, step=4,
+                    metrics={"train loss": 2.0, "comm MB/s": 3.25},
+                    throughput={"throughput/examples_per_sec": 512.0,
+                                "throughput/mfu": 0.5},
+                    guard={"guard/skipped": 1.0},
+                    timeline={}, step_spans=spans)
+            es.emit("guard", epoch=1, step=4, **{"guard/skipped": 1.0})
+        return p
+
+    def test_render_and_chrome(self, tmp_path):
+        import tools.trace_report as tr
+
+        events = obs_export.read_events(self._events(tmp_path))
+        bd = tr.phase_breakdown(events)
+        assert bd["data"]["mean_ms"] == pytest.approx(200.0)
+        assert bd["data"]["share"] == pytest.approx(0.2)
+        assert bd["device"]["mean_ms"] == pytest.approx(500.0)
+        rows = tr.throughput_rows(events)
+        assert rows[0]["rate"] == 512.0 and rows[0]["mfu"] == 0.5
+        report = tr.render_report(events)
+        assert "per-phase step-time breakdown" in report
+        assert "MFU" in report and "guard events: 1" in report
+        ch = tr.chrome_trace_events(events)
+        # 4 steps x (data + dispatch) + 1 device span
+        assert len(ch) == 9
+        assert all(e["ph"] == "X" and e["dur"] > 0 for e in ch)
+        out = str(tmp_path / "chrome.json")
+        assert tr.main([self._events(tmp_path), "--chrome", out]) == 0
+        assert json.load(open(out))["traceEvents"]
+
+    def test_schema_guard(self, tmp_path):
+        import tools.trace_report as tr
+
+        with pytest.raises(ValueError, match="schema version"):
+            tr.check_schema([{"v": 999, "kind": "epoch"}])
+
+
+@pytest.mark.quick
+class TestProfileTraceContext:
+    def test_stops_on_exception(self, monkeypatch):
+        """The hoisted profiler context must stop the trace when the epoch
+        raises (the leak the copy-pasted start/stop pairs had)."""
+        import jax
+
+        from tpu_compressed_dp.harness.loop import profile_trace
+
+        calls = []
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda d: calls.append(("start", d)))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: calls.append(("stop", None)))
+        with pytest.raises(RuntimeError):
+            with profile_trace("/tmp/x") as active:
+                assert active
+                raise RuntimeError("mid-epoch failure")
+        assert calls == [("start", "/tmp/x"), ("stop", None)]
+        # falsy dir: no-op, nothing started
+        with profile_trace(None) as active:
+            assert not active
+        assert len(calls) == 2
 
 
 @pytest.mark.quick
@@ -90,11 +506,12 @@ class TestMeters:
 def test_imagenet_harness_tensorboard_integration(tmp_path):
     from tpu_compressed_dp.harness import imagenet as h
 
-    h.main([
+    ev_path = str(tmp_path / "events.jsonl")
+    summary = h.main([
         "--synthetic", "--synthetic_n", "64", "--num_classes", "4",
         "--arch", "resnet18", "--width", "8", "--short_epoch", "--workers", "2",
         "--compress", "layerwise", "--method", "randomk", "--ratio", "0.1",
-        "--logdir", str(tmp_path), "--tensorboard",
+        "--logdir", str(tmp_path), "--tensorboard", "--events", ev_path,
     ])
     scalars = json.load(open(tmp_path / "tb" / "scalars.json"))
     assert "losses/top5" in scalars and "net/payload_mb_per_step" in scalars
@@ -104,3 +521,20 @@ def test_imagenet_harness_tensorboard_integration(tmp_path):
     assert xs == sorted(xs) and xs[0] > 0
     assert "~~0" in (tmp_path / "event.log").read_text()
     assert (tmp_path / "logs.tsv").exists()
+    # throughput + comm-rate columns reach the epoch summary
+    assert summary["img/s"] > 0
+    assert summary["comm MB/s"] > 0
+    # the JSONL event stream parses, is schema-versioned, and feeds
+    # trace_report's breakdown + throughput tables without error
+    import tools.trace_report as tr
+
+    events = obs_export.read_events(ev_path)
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert kinds.count("epoch") == 3
+    assert all(e["v"] == obs_export.SCHEMA_VERSION for e in events)
+    ep = next(e for e in events if e["kind"] == "epoch")
+    assert ep["throughput"]["throughput/examples_per_sec"] > 0
+    assert ep["step_spans"] and ep["timeline"]["time/steps_per_sec"] > 0
+    report = tr.render_report(events)
+    assert "per-phase step-time breakdown" in report and "MFU" in report

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Heartbeat watchdog — the check-only half of the ROADMAP watchdog item.
+
+Reads the liveness file a harness writes under ``--heartbeat`` (payload:
+``ts``, ``step``, ``last_good_step``, and the telemetry snapshot the
+observability layer added — step rate, p95 step latency) and exits nonzero
+when the run is unhealthy, so a cron job / systemd timer / supervisor can
+alert or relaunch:
+
+  exit 0  healthy
+  exit 1  unhealthy (stale / wedged / stalled; reasons on stdout)
+  exit 2  heartbeat missing or unreadable
+
+Checks (see :func:`tpu_compressed_dp.utils.resilience.check_heartbeat`):
+
+  * **stale** — ``ts`` older than ``--max_age``: process dead or hung.
+  * **wedged** — ``step - last_good_step > --max_wedge``: alive but every
+    step is being vetoed by the step guard (the failure liveness alone
+    cannot see; pair with ``--guard``).
+  * **stalled** — telemetry ``steps_per_sec`` below ``--min_step_rate``:
+    alive and applying updates, but crawling.
+
+Usage::
+
+    python tools/watchdog.py --check --heartbeat /path/hb.json
+    python tools/watchdog.py --check --heartbeat hb.json \\
+        --max_age 120 --max_wedge 200 --min_step_rate 0.01
+
+The auto-relaunch half (acting on this exit code) remains a ROADMAP open
+item; this tool deliberately only observes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from tpu_compressed_dp.utils.resilience import check_heartbeat, read_heartbeat
+
+
+def run_check(args) -> int:
+    # single read: passing the parsed record into check_heartbeat keeps the
+    # verdict and the printed payload consistent even if the harness's
+    # atomic os.replace lands mid-check
+    hb = read_heartbeat(args.heartbeat)
+    if hb is None:
+        print(f"watchdog: MISSING {args.heartbeat}")
+        return 2
+    problems = check_heartbeat(
+        args.heartbeat,
+        max_age_s=args.max_age,
+        max_wedge_steps=args.max_wedge,
+        min_steps_per_sec=args.min_step_rate,
+        hb=hb,
+    )
+    if problems:
+        for pr in problems:
+            print(f"watchdog: UNHEALTHY: {pr}")
+        return 1
+    tele = hb.get("telemetry") or {}
+    rate = tele.get("steps_per_sec")
+    print("watchdog: healthy "
+          f"(step={hb.get('step')}, last_good_step={hb.get('last_good_step')}"
+          + (f", {rate:.3g} steps/s" if isinstance(rate, (int, float)) else "")
+          + ")")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--check", action="store_true", required=True,
+                   help="run the health check (the only mode; the relaunch "
+                        "half is a ROADMAP open item)")
+    p.add_argument("--heartbeat", type=str, required=True,
+                   help="heartbeat JSON path (harness --heartbeat)")
+    p.add_argument("--max_age", type=float, default=60.0,
+                   help="seconds before a heartbeat counts as stale "
+                        "(choose > the harness --heartbeat_interval)")
+    p.add_argument("--max_wedge", type=int, default=None,
+                   help="max steps last_good_step may trail the attempt "
+                        "counter (default: no wedge check)")
+    p.add_argument("--min_step_rate", type=float, default=None,
+                   help="min telemetry steps/sec (default: no stall check)")
+    return run_check(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,8 +31,10 @@ import numpy as np
 
 from tpu_compressed_dp.data import cifar10 as data
 from tpu_compressed_dp.harness.loop import (add_robustness_args,
-                                            build_robustness, make_heartbeat,
-                                            train_epoch)
+                                            add_telemetry_args,
+                                            build_robustness,
+                                            make_event_stream, make_heartbeat,
+                                            profile_trace, train_epoch)
 from tpu_compressed_dp.models import alexnet as alexnet_mod
 from tpu_compressed_dp.models import resnet9 as resnet9_mod
 from tpu_compressed_dp.models import vgg as vgg_mod
@@ -195,6 +197,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     # robustness: shared --guard*/--chaos/--heartbeat surface
     add_robustness_args(p, check_note="checked at epoch end")
+    # telemetry: shared --events/--prom surface (obs/export.py)
+    add_telemetry_args(p)
     p.add_argument("--tensorboard", action="store_true",
                    help="write tensorboard scalars under <log_dir>/tb")
     p.add_argument("--profile_epoch", type=int, default=None,
@@ -404,40 +408,95 @@ def run(args) -> dict:
         if args.log_dir and args.tensorboard and rank0 else None
     )
     hb = make_heartbeat(args)
+    from tpu_compressed_dp.obs.export import telemetry_snapshot, write_prometheus
+    from tpu_compressed_dp.obs.trace import StepTimeline
+    from tpu_compressed_dp.utils import flops as flops_mod
+
+    timeline = StepTimeline()
+    events = make_event_stream(
+        args, harness="dawn", network=args.network,
+        method=args.method, compress=args.compress, mode=args.mode,
+        transport=args.transport, batch_size=bs, devices=ndev, epochs=epochs)
+    # Per-chip forward FLOPs from XLA's cost model, once (the epoch loop
+    # scales it by the measured step rate — utils/flops.py conventions:
+    # train = 3x fwd, MFU vs the chip's bf16 peak, omitted off-TPU).  The
+    # cost-model pass compiles the bare forward; skip it when nothing can
+    # consume the result (no exporter and no known chip peak — the CPU
+    # smoke-test case, where it would only slow every run down).
+    want_flops = (events is not None or bool(args.prom)
+                  or flops_mod.chip_peak_flops() is not None)
+    fwd_flops = flops_mod.fwd_flops_xla(
+        lambda p, s, x: apply_fn(p, s, x, True, {}),
+        params, stats, jnp.zeros((bs // ndev, 32, 32, 3), jnp.float32)
+    ) if want_flops else None
+    prev_skipped = 0.0
     summary = {}
     # finally-guarded: GuardExceeded / ChaosCrash / any training failure must
     # not leak the heartbeat writer thread — an orphaned writer keeps
     # refreshing ts and turns a dead run into a stale-detection false
-    # negative (the exact failure mode the watchdog reads this file for)
+    # negative (the exact failure mode the watchdog reads this file for) —
+    # nor a running profiler trace or an unterminated event stream
     try:
         for epoch in range(epochs):
             profiling = args.profile_epoch == epoch and args.log_dir
-            if profiling:
-                jax.profiler.start_trace(os.path.join(args.log_dir, "profile"))
             train_step = train_step_for(ratio_for_epoch(epoch))
-            state, epoch_stats = train_epoch(
-                train_step, eval_step, state, train_batches, test_batches, timer, bs,
-                test_time_in_total=False,
-                crash=crash, step_offset=int(state.step), guard_cfg=guard_cfg,
-            )
-            if profiling:
-                jax.profiler.stop_trace()
+            with profile_trace(
+                    os.path.join(args.log_dir, "profile") if profiling else None):
+                state, epoch_stats, acc = train_epoch(
+                    train_step, eval_step, state, train_batches, test_batches,
+                    timer, bs, test_time_in_total=False,
+                    crash=crash, step_offset=int(state.step),
+                    guard_cfg=guard_cfg, timeline=timeline, world=ndev,
+                )
+            train_time = epoch_stats["train time"]
+            examples = len(train_batches) * bs
+            thr = flops_mod.throughput_record(
+                fwd_flops, acc.steps / max(train_time, 1e-9),
+                examples_per_sec=examples / max(train_time, 1e-9))
             if hb is not None:
                 # last_good_step: the watchdog's "is it making progress" signal
                 # — a wedged-but-alive run (skipping every step) beats but stops
-                # advancing this field
+                # advancing this field.  The telemetry snapshot adds step rate
+                # + p95 latency for the watchdog's stall check.
                 hb.update(
                     step=int(state.step),
                     last_good_step=(int(state.guard.last_good_step)
                                     if guard_cfg is not None else int(state.step)),
                     epoch=epoch,
+                    telemetry=telemetry_snapshot(timeline),
                 )
             summary = {
                 "epoch": epoch + 1,
                 "lr": float(sched((epoch + 1))),
                 **{k: (float(v) if isinstance(v, (int, float, np.floating)) else v)
                    for k, v in epoch_stats.items()},
+                "img/s": round(thr.get("throughput/examples_per_sec", 0.0), 1),
             }
+            if "throughput/mfu" in thr:
+                summary["mfu"] = round(thr["throughput/mfu"], 4)
+            guard_last = {k: v for k, v in acc.last.items()
+                          if k.startswith("guard/")}
+            comm_means = {k: acc.mean(k) for k in acc.sums
+                          if k.startswith("comm/")}
+            if events is not None:
+                events.emit(
+                    "epoch", epoch=epoch + 1, step=int(state.step),
+                    metrics={k: v for k, v in summary.items()
+                             if isinstance(v, (int, float))},
+                    throughput=thr, comm=comm_means, guard=guard_last,
+                    timeline=timeline.snapshot(),
+                    step_spans=timeline.drain())
+                skipped = guard_last.get("guard/skipped", 0.0)
+                if skipped > prev_skipped:
+                    events.emit("guard", epoch=epoch + 1,
+                                step=int(state.step), **guard_last)
+                prev_skipped = skipped
+            if args.prom and rank0:
+                write_prometheus(
+                    {"loss": summary["train loss"], "lr": summary["lr"],
+                     **thr, **comm_means, **guard_last,
+                     **timeline.snapshot()},
+                    args.prom, labels={"harness": "dawn"})
             if rank0:
                 table.append(summary)
                 tsv.append(summary)
@@ -449,6 +508,8 @@ def run(args) -> dict:
             tsv.save(args.log_dir)
     finally:
         tb.close()
+        if events is not None:
+            events.close()
         if hb is not None:
             hb.stop()
     return summary

@@ -15,6 +15,8 @@ Smoke run (CPU, 8 virtual devices):
 from __future__ import annotations
 
 import argparse
+import contextlib
+import os
 import time
 from typing import Dict, Optional
 
@@ -107,9 +109,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "via comm/shard_overflow)")
     p.add_argument("--error_feedback", action="store_true")
     # robustness: shared --guard*/--chaos/--heartbeat surface
-    from tpu_compressed_dp.harness.loop import add_robustness_args
+    from tpu_compressed_dp.harness.loop import (add_robustness_args,
+                                                add_telemetry_args)
 
     add_robustness_args(p, check_note="checked every --log_every")
+    # telemetry: shared --events/--prom surface (obs/export.py)
+    add_telemetry_args(p)
+    p.add_argument("--logdir", type=str, default=None,
+                   help="output dir for profiler traces")
+    p.add_argument("--profile_epoch", type=int, default=None,
+                   help="jax.profiler-trace the Nth --log_every window of "
+                        "steps to <logdir>/profile (the LM loop's 'epoch' "
+                        "is one log window)")
     # plumbing
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log_every", type=int, default=10)
@@ -253,27 +264,56 @@ def run(args) -> Dict[str, float]:
           f"method={comp.method or 'dense'}/{comp.granularity}/{comp.mode}")
 
     table = TableLogger()
-    from tpu_compressed_dp.utils.meters import GuardMeter
+    from tpu_compressed_dp.utils.meters import GuardMeter, per_chip_comm_bytes
 
     guard_meter = GuardMeter()
-    from tpu_compressed_dp.harness.loop import make_heartbeat
+    from tpu_compressed_dp.harness.loop import (make_event_stream,
+                                                make_heartbeat, profile_trace)
+    from tpu_compressed_dp.obs.export import (telemetry_snapshot,
+                                              write_prometheus)
+    from tpu_compressed_dp.obs.trace import StepTimeline
 
     hb = make_heartbeat(args)
+    timeline = StepTimeline()
+    events = make_event_stream(
+        args, harness="lm", preset=args.preset, mesh=mesh_str,
+        method=comp.method or "none", compress=args.compress, mode=args.mode,
+        transport=args.transport, seq_len=args.seq_len,
+        global_batch=args.global_batch, steps=args.steps)
+    # --profile_epoch: trace the Nth log window.  ExitStack (not a `with`)
+    # because the window opens and closes mid-loop; the outer finally
+    # guarantees the stop even when the loop raises inside the window —
+    # the same leak-proofing profile_trace gives the CNN harnesses.
+    prof = contextlib.ExitStack()
+    prof_window = None
+    if args.profile_epoch is not None and args.logdir:
+        w0 = args.profile_epoch * args.log_every
+        prof_window = (w0, w0 + args.log_every)
     t0 = time.time()
     tokens_done = 0.0
     summary: Dict[str, float] = {}
     start = int(state.step)
     timed_from = start
+    world = dp * args.sp  # gradient-sync workers (transport arithmetic)
+    prev_skipped = 0.0
     # finally-guarded: GuardExceeded / ChaosCrash must not leak the
-    # heartbeat writer thread or the checkpoint manager; the final save
-    # stays on the clean path only
+    # heartbeat writer thread, the checkpoint manager, a running profiler
+    # trace, or an unterminated event stream; the final save stays on the
+    # clean path only
     try:
         for step_i in range(start, args.steps):
+            if prof_window is not None and step_i == prof_window[0]:
+                prof.enter_context(
+                    profile_trace(os.path.join(args.logdir, "profile")))
             if crash is not None:
                 crash.check(step_i)
             batch = ds.batch(step_i)
+            timeline.batch_ready()
             state, metrics = train_step(
                 state, {k: jnp.asarray(v) for k, v in batch.items()})
+            timeline.step_dispatched()
+            if prof_window is not None and step_i + 1 == prof_window[1]:
+                prof.close()
             if step_i <= start + 1:
                 # steady-state tokens/sec: the jitted step compiles TWICE (the
                 # donated-buffer layouts change the arg signature on call 2), so
@@ -283,6 +323,7 @@ def run(args) -> Dict[str, float]:
                 jax.device_get(metrics)
                 t0 = time.time()
                 timed_from = step_i + 1
+                timeline.resume()  # the compile drain is not data wait
             if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
                 m = jax.device_get(metrics)
                 if guard_cfg is not None:
@@ -296,6 +337,7 @@ def run(args) -> Dict[str, float]:
                         step=step_i + 1,
                         last_good_step=(int(m["guard/last_good_step"])
                                         if guard_cfg is not None else step_i + 1),
+                        telemetry=telemetry_snapshot(timeline),
                     )
                 steps_timed = step_i + 1 - timed_from
                 tokens_done = steps_timed * args.global_batch * args.seq_len
@@ -307,30 +349,72 @@ def run(args) -> Dict[str, float]:
                     # 0.0 until at least one post-compile step is in the window
                     "tok/s": round(tokens_done / dt, 1) if steps_timed > 0 else 0.0,
                 }
+                thr: Dict[str, float] = {}
                 if steps_timed > 0:
                     # MFU (VERDICT r2 #3): closed-form 6N + 12Lds per token
-                    # (utils/flops.py), per chip, vs the chip's bf16 peak
+                    # (utils/flops.py), per chip, vs the chip's bf16 peak —
+                    # per-chip fwd flops feed the shared throughput_record
+                    # epilogue the CNN harnesses use
                     from tpu_compressed_dp.utils import flops as flops_mod
 
                     tok_flops = flops_mod.transformer_train_flops_per_token(
                         n_params, cfg.n_layers, cfg.dim, args.seq_len)
                     n_chips = max(len(jax.devices()), 1)
-                    u = flops_mod.mfu(tok_flops * (tokens_done / dt) / n_chips)
-                    if u is not None:
-                        summary["mfu"] = round(u, 4)
+                    tok_s = tokens_done / dt
+                    fwd_per_chip = (tok_flops / 3.0) * (
+                        args.global_batch * args.seq_len) / n_chips
+                    thr = flops_mod.throughput_record(
+                        fwd_per_chip, steps_timed / dt, tokens_per_sec=tok_s)
+                    if "throughput/mfu" in thr:
+                        summary["mfu"] = round(thr["throughput/mfu"], 4)
+                comm_m = {k: float(v) for k, v in m.items()
+                          if k.startswith("comm/")}
                 if "comm/sent_elems" in m:
                     summary["sent frac"] = float(m["comm/sent_elems"]) / max(
                         float(m["comm/dense_elems"]), 1.0)
                     summary["wire frac"] = float(m["comm/sent_bits"]) / (
                         32.0 * max(float(m["comm/dense_elems"]), 1.0))
+                    per_chip_b = per_chip_comm_bytes(comm_m, world)
+                    if per_chip_b is not None and steps_timed > 0:
+                        summary["comm MB/s"] = round(
+                            per_chip_b * (steps_timed / dt) / 1e6, 3)
+                guard_last = {k: float(v) for k, v in m.items()
+                              if k.startswith("guard/")}
                 if guard_cfg is not None:
                     gsum = guard_meter.summary()
                     summary["skipped"] = gsum.get("guard/skipped", 0.0)
                     summary["loss_scale"] = gsum.get("guard/loss_scale", 1.0)
+                if events is not None:
+                    events.emit(
+                        "step", step=step_i + 1,
+                        metrics={k: v for k, v in summary.items()
+                                 if isinstance(v, (int, float))},
+                        throughput=thr, comm=comm_m, guard=guard_last,
+                        timeline=timeline.snapshot(),
+                        step_spans=timeline.drain())
+                    # delta-gate on the cumulative counter: one guard event
+                    # per window that actually skipped, not one per window
+                    # forever after the first skip
+                    skipped_now = guard_last.get("guard/skipped", 0.0)
+                    if skipped_now > prev_skipped:
+                        events.emit("guard", step=step_i + 1, **guard_last)
+                    prev_skipped = skipped_now
+                if args.prom and jax.process_index() == 0:
+                    write_prometheus(
+                        {"loss": summary["loss"], "lr": summary["lr"],
+                         **thr, **comm_m, **guard_last,
+                         **timeline.snapshot()},
+                        args.prom, labels={"harness": "lm"})
                 table.append(summary)
+                # the log window's device_get drain + export work is not the
+                # next step's input-pipeline wait
+                timeline.resume()
         if ckpt:
             ckpt.save(state, {"step": int(state.step)})
     finally:
+        prof.close()
+        if events is not None:
+            events.close()
         if hb is not None:
             hb.stop()
         if ckpt:

@@ -8,6 +8,7 @@ globally-reduced metrics.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, Iterable, Optional, Tuple
 
 import jax
@@ -20,7 +21,51 @@ from tpu_compressed_dp.utils.timer import Timer
 
 __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
            "comm_summary", "guard_summary", "add_robustness_args",
-           "build_robustness", "make_heartbeat"]
+           "add_telemetry_args", "build_robustness", "make_heartbeat",
+           "make_event_stream", "profile_trace"]
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: Optional[str]):
+    """``jax.profiler`` trace capture with a guaranteed stop.
+
+    The harnesses used to copy-paste ``start_trace``/``stop_trace`` around
+    the profiled epoch with no try/finally — an exception mid-epoch (e.g.
+    ``GuardExceeded``) leaked a running trace, which keeps buffering
+    profiler events for the rest of the process AND makes the next
+    ``start_trace`` raise.  One context manager, used by all three
+    harnesses; no-op (yields False) when ``trace_dir`` is falsy."""
+    if not trace_dir:
+        yield False
+        return
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield True
+    finally:
+        jax.profiler.stop_trace()
+
+
+def add_telemetry_args(p) -> None:
+    """The shared ``--events`` / ``--prom`` CLI surface (obs/export.py)."""
+    p.add_argument("--events", type=str, default=None,
+                   help="JSONL telemetry event stream path (schema-versioned;"
+                        " one record per step/epoch/guard event — feed to "
+                        "tools/trace_report.py)")
+    p.add_argument("--prom", type=str, default=None,
+                   help="Prometheus textfile path, rewritten atomically at "
+                        "each epoch/log window with the latest metrics")
+
+
+def make_event_stream(args, **meta):
+    """The harnesses' ``--events`` setup: a started
+    :class:`~tpu_compressed_dp.obs.export.EventStream` on the master rank
+    (metrics are globally reduced, every rank would write identical
+    records), or None."""
+    if not getattr(args, "events", None) or jax.process_index() != 0:
+        return None
+    from tpu_compressed_dp.obs.export import EventStream
+
+    return EventStream(args.events, meta=dict(meta))
 
 
 def add_robustness_args(p, *, check_note: str) -> None:
@@ -131,6 +176,7 @@ def pad_batch(batch: Dict[str, np.ndarray], size: int) -> Dict[str, np.ndarray]:
 
 def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
                     *, crash=None, step_offset: int = 0, guard_cfg=None,
+                    timeline=None,
                     ) -> Tuple[TrainState, MetricAccumulator]:
     # Metrics stay on device until the epoch ends: a per-step float() would
     # block host batch prep on the device and serialize the pipeline (JAX's
@@ -145,12 +191,24 @@ def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
     # end (per-step checks would force a device sync each step and
     # serialize the pipeline; detection latency here is one epoch, and the
     # raise lands inside run_with_recovery's retry loop like any failure).
+    #
+    # ``timeline`` (obs/trace.StepTimeline) splits each step's host time
+    # into input-pipeline wait (the `next()` inside the for statement) and
+    # dispatch; it never syncs the device unless configured to sample.
     acc = MetricAccumulator()
     step_metrics = []
+    if timeline is not None:
+        # exclude whatever happened since the previous epoch's last dispatch
+        # (eval, checkpoint saves, loader swaps) from step 0's data wait
+        timeline.resume()
     for i, batch in enumerate(batches):
+        if timeline is not None:
+            timeline.batch_ready()
         if crash is not None:
             crash.check(step_offset + i)
         state, metrics = train_step(state, {k: jnp.asarray(v) for k, v in batch.items()})
+        if timeline is not None:
+            timeline.step_dispatched()
         step_metrics.append(metrics)
     fetched = jax.device_get(step_metrics)
     for metrics in fetched:
@@ -190,13 +248,20 @@ def train_epoch(
     crash=None,
     step_offset: int = 0,
     guard_cfg=None,
-) -> Tuple[TrainState, Dict[str, float]]:
+    timeline=None,
+    world: Optional[int] = None,
+) -> Tuple[TrainState, Dict[str, float], MetricAccumulator]:
     """One train + eval pass with the reference's epoch-summary shape
-    (`core.py:324-331`).  ``crash``/``step_offset``/``guard_cfg`` pass
-    through to :func:`run_train_epoch`."""
+    (`core.py:324-331`).  ``crash``/``step_offset``/``guard_cfg``/
+    ``timeline`` pass through to :func:`run_train_epoch`; with ``world``
+    the summary gains the analytic per-chip comm rate ('comm MB/s', the
+    transport-split arithmetic of ``utils.meters.per_chip_comm_bytes``).
+    Also returns the epoch's :class:`MetricAccumulator` so callers can
+    export raw metric means (event stream, Prometheus) without re-running
+    the reduction."""
     state, train_acc = run_train_epoch(
         train_step, state, train_batches, crash=crash,
-        step_offset=step_offset, guard_cfg=guard_cfg)
+        step_offset=step_offset, guard_cfg=guard_cfg, timeline=timeline)
     train_time = timer()
     test_stats = run_eval(eval_step, state, test_batches, batch_size)
     test_time = timer(test_time_in_total)
@@ -211,4 +276,12 @@ def train_epoch(
     }
     summary.update(comm_summary(train_acc))
     summary.update(guard_summary(train_acc))
-    return state, summary
+    if world:
+        from tpu_compressed_dp.utils.meters import per_chip_comm_bytes
+
+        comm_b = per_chip_comm_bytes(
+            {k: train_acc.mean(k) for k in train_acc.sums
+             if k.startswith("comm/")}, world)
+        if comm_b is not None and train_time > 0:
+            summary["comm MB/s"] = comm_b * train_acc.steps / train_time / 1e6
+    return state, summary, train_acc

@@ -1,0 +1,28 @@
+"""Unified telemetry subsystem.
+
+  * :mod:`~tpu_compressed_dp.obs.registry` — typed metric registry: every
+    stat key the system emits, declared once with kind/unit/cross-worker
+    reduction; the conformance test fails on undeclared keys.
+  * :mod:`~tpu_compressed_dp.obs.trace` — phase-level step tracing:
+    ``jax.named_scope`` phase annotations through both sync engines, the
+    sharded wire path and all three step factories, plus the host-side
+    :class:`~tpu_compressed_dp.obs.trace.StepTimeline` ring buffer
+    (p50/p95/p99 step latency, data-wait fraction, step rate).
+  * :mod:`~tpu_compressed_dp.obs.export` — schema-versioned JSONL event
+    stream, Prometheus textfile exporter, and the heartbeat telemetry
+    snapshot consumed by ``tools/watchdog.py --check``.
+"""
+
+from tpu_compressed_dp.obs import export, registry, trace
+from tpu_compressed_dp.obs.export import (EventStream, SCHEMA_VERSION,
+                                          read_events, telemetry_snapshot,
+                                          write_prometheus)
+from tpu_compressed_dp.obs.registry import MetricSpec
+from tpu_compressed_dp.obs.trace import PHASES, StepTimeline, phase
+
+__all__ = [
+    "registry", "trace", "export",
+    "MetricSpec", "PHASES", "StepTimeline", "phase",
+    "EventStream", "SCHEMA_VERSION", "read_events", "telemetry_snapshot",
+    "write_prometheus",
+]

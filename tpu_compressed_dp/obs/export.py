@@ -1,0 +1,138 @@
+"""Exportable telemetry: JSONL event stream + Prometheus textfile.
+
+  * :class:`EventStream` — one JSON record per run/step/epoch/guard event,
+    schema-versioned (``v``), append-only, flushed per record so a watchdog
+    or tail -f sees events as they happen.  ``tools/trace_report.py``
+    consumes this stream offline.
+  * :func:`write_prometheus` — node-exporter-textfile-style exposition of
+    the latest metric values, with ``# TYPE`` / ``# HELP`` lines sourced
+    from the metric registry (:mod:`tpu_compressed_dp.obs.registry`).
+    Atomic replace, so a scraper never reads a partial file.
+  * :func:`telemetry_snapshot` — the compact health payload the heartbeat
+    carries (step rate, p95 latency, ``last_good_step``), consumed by
+    ``tools/watchdog.py --check``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from tpu_compressed_dp.obs import registry
+
+__all__ = ["SCHEMA_VERSION", "EventStream", "read_events",
+           "write_prometheus", "telemetry_snapshot"]
+
+#: Bump when a record's field meaning changes incompatibly; consumers
+#: (trace_report, watchdog, tests) check it before interpreting fields.
+SCHEMA_VERSION = 1
+
+
+class EventStream:
+    """Append-only JSONL event writer.
+
+    Every record carries ``v`` (schema version), ``kind`` and ``ts``
+    (host epoch seconds); the constructor writes a ``run_start`` record
+    with the caller's metadata, ``close()`` a ``run_end``.  Values must be
+    JSON-serialisable — pass plain floats, not device arrays.
+    """
+
+    def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a")
+        self._closed = False
+        self.emit("run_start", **(meta or {}))
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        rec = {"v": SCHEMA_VERSION, "kind": kind, "ts": time.time(), **fields}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.emit("run_end")
+        self._f.close()
+        self._closed = True
+
+    def __enter__(self) -> "EventStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL event stream (strict: a malformed line raises — a
+    partial tail line is a bug, the writer flushes whole records)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def write_prometheus(metrics: Dict[str, float], path: str,
+                     labels: Optional[Dict[str, str]] = None) -> str:
+    """Write ``metrics`` in Prometheus text exposition format to ``path``.
+
+    Keys may be registry-canonical (``comm/sent_bits``) or ad-hoc; declared
+    metrics get a ``# HELP`` line from their spec.  Everything is exposed
+    as ``gauge``: the harnesses write per-step/per-window aggregates
+    (epoch means, the latest window's value), not process-lifetime running
+    totals — exposing those as Prometheus counters would make ``rate()``
+    treat every dip as a counter reset.  (The registry's ``counter`` kind
+    describes the metric's additive nature across workers/steps, not its
+    exposition form here.)  Non-numeric values are skipped.  Atomic
+    tmp+replace so scrapers never see a torn file."""
+    label_str = ""
+    if labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        label_str = "{" + inner + "}"
+    lines = []
+    for key in sorted(metrics):
+        val = metrics[key]
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        pname = registry.prometheus_name(key)
+        if registry.is_declared(key):
+            ms = registry.spec(key)
+            if ms.help:
+                lines.append(f"# HELP {pname} {ms.help}")
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname}{label_str} {float(val):g}")
+    body = "\n".join(lines) + "\n"
+    tmp = path + ".tmp"
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(tmp, "w") as f:
+        f.write(body)
+    os.replace(tmp, path)
+    return body
+
+
+def telemetry_snapshot(timeline=None, *, step: Optional[int] = None,
+                       last_good_step: Optional[int] = None
+                       ) -> Dict[str, float]:
+    """The heartbeat's health payload: step rate + p95 latency from the
+    :class:`~tpu_compressed_dp.obs.trace.StepTimeline` window, plus the
+    progress watermarks the watchdog's wedge check reads."""
+    out: Dict[str, float] = {}
+    if step is not None:
+        out["step"] = int(step)
+    if last_good_step is not None:
+        out["last_good_step"] = int(last_good_step)
+    if timeline is not None:
+        snap = timeline.snapshot()
+        out["steps_per_sec"] = snap["time/steps_per_sec"]
+        out["step_p95_ms"] = snap["time/step_p95_ms"]
+        out["data_wait_frac"] = snap["time/data_wait_frac"]
+    return out

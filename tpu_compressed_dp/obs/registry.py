@@ -1,0 +1,203 @@
+"""Typed metric registry — the single source of truth for every stat key.
+
+The reference (and this repo until now) accreted stringly-typed stats keys
+across two sync engines, three step factories and three harnesses; nothing
+enforced that a new ``comm/*`` key carried a sane cross-worker reduction or
+that the harness epilogues even knew it existed.  Here every metric the
+system emits is declared ONCE, with:
+
+  * ``kind`` — ``counter`` (monotone / additive volume), ``gauge``
+    (point-in-time value) or ``timing`` (latency/duration);
+  * ``unit`` — what one unit of the value means (``bits``, ``elems``,
+    ``examples``, ``seconds``...), so exporters never guess;
+  * ``reduction`` — how the value combines ACROSS WORKERS: ``mean`` /
+    ``sum`` for volumes, ``min`` / ``max`` for 0/1 diagnostics and
+    monotone watermarks (``sync_agree`` is a unanimity verdict — pmin;
+    ``guard/nonfinite`` is an any-worker alarm — pmax).  The partitioned
+    sync engine (:mod:`tpu_compressed_dp.parallel.dp`) derives its
+    diagnostic-reduction table from these declarations, so a reduction can
+    never silently disagree between the registry and the engine;
+  * ``emitter`` — which layer produces it: ``engine`` (inside
+    ``sync(...)``, raw key later prefixed ``comm/`` by the step factories),
+    ``step`` (the jitted train step), ``eval`` (the eval step), or
+    ``host`` (harness-side derived telemetry: throughput, MFU, latency
+    percentiles).
+
+The conformance test (tests/test_observability.py) traces both sync engines
+across the full method x transport x granularity matrix and fails on any
+emitted key that is not declared here — adding a stat without declaring it
+is a test failure, not a silent new string.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List
+
+__all__ = [
+    "MetricSpec", "REGISTRY", "declare", "canonical", "spec", "is_declared",
+    "undeclared", "engine_diag_reductions", "prometheus_name",
+    "COUNTER", "GAUGE", "TIMING",
+]
+
+COUNTER = "counter"
+GAUGE = "gauge"
+TIMING = "timing"
+_KINDS = (COUNTER, GAUGE, TIMING)
+_REDUCTIONS = ("mean", "sum", "min", "max")
+_EMITTERS = ("engine", "step", "eval", "host")
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    name: str       # canonical full name (engines emit it without "comm/")
+    kind: str       # counter | gauge | timing
+    unit: str       # bits, elems, examples, tokens, seconds, ratio, ...
+    reduction: str  # cross-worker combine: mean | sum | min | max
+    emitter: str    # engine | step | eval | host
+    help: str = ""
+
+
+REGISTRY: Dict[str, MetricSpec] = {}
+
+
+def declare(name: str, kind: str, unit: str, reduction: str, emitter: str,
+            help: str = "") -> MetricSpec:
+    """Register one metric; redeclaring with a different spec is an error
+    (two subsystems fighting over one key is exactly the bug class the
+    registry exists to kill)."""
+    if kind not in _KINDS:
+        raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
+    if reduction not in _REDUCTIONS:
+        raise ValueError(
+            f"reduction must be one of {_REDUCTIONS}, got {reduction!r}")
+    if emitter not in _EMITTERS:
+        raise ValueError(f"emitter must be one of {_EMITTERS}, got {emitter!r}")
+    ms = MetricSpec(name, kind, unit, reduction, emitter, help)
+    prev = REGISTRY.get(name)
+    if prev is not None and prev != ms:
+        raise ValueError(f"metric {name!r} already declared as {prev}")
+    REGISTRY[name] = ms
+    return ms
+
+
+# --- engine-emitted (sync stats; step factories prefix raw keys "comm/",
+#     except guard/* which the guard wrapper emits pre-prefixed) ----------
+declare("comm/sent_elems", COUNTER, "elems", "mean", "engine",
+        "elements the wire representation carries this step")
+declare("comm/sent_bits", COUNTER, "bits", "mean", "engine",
+        "payload bits on the wire this step (analytic in simulate mode, "
+        "measured in wire mode)")
+declare("comm/sent_bits_psum", COUNTER, "bits", "mean", "engine",
+        "payload bits riding the psum ring (2(W-1)/W per-chip traffic)")
+declare("comm/sent_bits_allgather", COUNTER, "bits", "mean", "engine",
+        "payload bits riding an all_gather ((W-1)x per-chip traffic)")
+declare("comm/sent_bits_alltoall", COUNTER, "bits", "mean", "engine",
+        "payload bits riding the sharded transport's all_to_all route "
+        "((W-1)/W per-chip traffic)")
+declare("comm/dense_elems", GAUGE, "elems", "mean", "engine",
+        "uncompressed gradient size (the compression denominator)")
+declare("comm/num_collectives", GAUGE, "collectives", "mean", "engine",
+        "collectives issued per sync (granularity-dependent)")
+declare("comm/sync_agree", GAUGE, "bool", "min", "engine",
+        "check_sync verdict: 1.0 = every worker selected identical "
+        "indices / holds an identical warm start (unanimity -> pmin)")
+declare("comm/threshold_overflow", COUNTER, "elems", "mean", "engine",
+        "threshold-method survivors clipped by the fixed wire capacity")
+declare("comm/topk_surplus_dropped", COUNTER, "elems", "mean", "engine",
+        "above-threshold tie survivors beyond keep, truncated (EF off)")
+declare("comm/shard_overflow", COUNTER, "elems", "mean", "engine",
+        "coordinates clipped by the sharded transport's route/return caps")
+declare("guard/nonfinite", GAUGE, "bool", "max", "engine",
+        "1.0 = this step was vetoed by the finiteness vote "
+        "(any-worker alarm -> pmax)")
+
+# --- step-emitted (jitted train step, already globally reduced) ---------
+declare("loss", GAUGE, "nats", "mean", "step", "global mean train loss")
+declare("lr", GAUGE, "lr", "mean", "step", "learning rate at this step")
+declare("correct", COUNTER, "examples", "sum", "step",
+        "top-1 correct examples this step (global)")
+declare("count", COUNTER, "examples", "sum", "step",
+        "examples this step (global)")
+declare("tokens", COUNTER, "tokens", "sum", "step",
+        "tokens this step (global)")
+declare("guard/loss_scale", GAUGE, "scale", "mean", "step",
+        "live dynamic loss scale (replicated)")
+declare("guard/skipped", COUNTER, "steps", "max", "step",
+        "cumulative vetoed steps (monotone, replicated)")
+declare("guard/skip_streak", GAUGE, "steps", "max", "step",
+        "consecutive vetoed steps ending at this step")
+declare("guard/last_good_step", GAUGE, "steps", "max", "step",
+        "last step whose update was applied")
+
+# --- eval-step emitted ---------------------------------------------------
+declare("loss_sum", COUNTER, "nats", "sum", "eval", "summed eval loss")
+declare("correct5", COUNTER, "examples", "sum", "eval",
+        "top-5 correct examples (global)")
+
+# --- host-derived telemetry (harness epilogues / exporters) -------------
+declare("throughput/examples_per_sec", GAUGE, "examples/s", "mean", "host",
+        "global training throughput over the window")
+declare("throughput/tokens_per_sec", GAUGE, "tokens/s", "mean", "host",
+        "global token throughput over the window")
+declare("throughput/model_tflops_per_chip", GAUGE, "tflops", "mean", "host",
+        "model (fwd+bwd) TFLOP/s per chip at the measured rate")
+declare("throughput/mfu", GAUGE, "ratio", "mean", "host",
+        "model FLOPs utilisation vs the chip's bf16 peak")
+declare("net/comm_mb_per_sec", GAUGE, "MB/s", "mean", "host",
+        "analytic per-chip gradient-sync link traffic at the measured rate")
+declare("time/step_p50_ms", TIMING, "ms", "mean", "host",
+        "median host-observed step latency over the timeline window")
+declare("time/step_p95_ms", TIMING, "ms", "mean", "host",
+        "p95 host-observed step latency")
+declare("time/step_p99_ms", TIMING, "ms", "mean", "host",
+        "p99 host-observed step latency")
+declare("time/data_wait_frac", GAUGE, "ratio", "mean", "host",
+        "fraction of step wall time spent waiting on the input pipeline")
+declare("time/steps_per_sec", GAUGE, "steps/s", "mean", "host",
+        "host-observed step rate over the timeline window")
+
+
+def canonical(key: str) -> str:
+    """Map a raw engine stat key to its canonical registry name.
+
+    The step factories prefix engine stats with ``comm/`` (guard/* keys
+    pass through); this applies the same mapping so conformance checks can
+    consume either form."""
+    if key in REGISTRY or "/" in key:
+        return key
+    prefixed = f"comm/{key}"
+    return prefixed if prefixed in REGISTRY else key
+
+
+def is_declared(key: str) -> bool:
+    return canonical(key) in REGISTRY
+
+
+def spec(key: str) -> MetricSpec:
+    return REGISTRY[canonical(key)]
+
+
+def undeclared(keys: Iterable[str]) -> List[str]:
+    """The subset of ``keys`` (raw or canonical) missing from the registry."""
+    return sorted(k for k in keys if not is_declared(k))
+
+
+def engine_diag_reductions() -> Dict[str, str]:
+    """Raw engine keys whose cross-worker reduction is min/max — the 0/1
+    diagnostics the partitioned sync must NOT psum over model axes.  Keyed
+    by the raw (un-prefixed) name the engines emit; the single source the
+    engine's diagnostic table is built from."""
+    out = {}
+    for name, ms in REGISTRY.items():
+        if ms.emitter != "engine" or ms.reduction not in ("min", "max"):
+            continue
+        raw = name[len("comm/"):] if name.startswith("comm/") else name
+        out[raw] = ms.reduction
+    return out
+
+
+def prometheus_name(key: str) -> str:
+    """``comm/sent_bits`` -> ``tcdp_comm_sent_bits`` (exposition-safe)."""
+    return "tcdp_" + re.sub(r"[^a-zA-Z0-9_]", "_", canonical(key))

@@ -896,12 +896,20 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
         bits_ag = 0.0
         bits_a2a = 0.0
         dense_total = 0.0
+        from tpu_compressed_dp.obs import trace as obs_trace
+
         for gi, idxs in enumerate(groups):
             flat = group_concat(leaves, idxs)
-            ef_flat = group_concat(ef_leaves, idxs) if use_ef else None
+            with obs_trace.phase("ef"):
+                ef_flat = group_concat(ef_leaves, idxs) if use_ef else None
             ki = compressors.leaf_key(key, gi, per_worker_rng, axis_name)
-            (dense, new_ef_flat, sent_leaf, bits_leaf, bits_route, agree,
-             leaf_overflows) = sync_flat(flat, ef_flat, ki, world)
+            # one scope over the whole wire leaf sync (select + pack +
+            # combine): the sharded transport's route/reduce/return scopes
+            # nest inside (xprof shows tcdp.compress/tcdp.route etc.), and
+            # the allgather combine's collectives split out by op name
+            with obs_trace.phase("compress"):
+                (dense, new_ef_flat, sent_leaf, bits_leaf, bits_route, agree,
+                 leaf_overflows) = sync_flat(flat, ef_flat, ki, world)
             # which collective(s) this group's payload actually rode
             # (VERDICT r2 #2) — shared classifier with the simulate engine.
             # A sharded group splits: route bits ride the all_to_all, the
@@ -914,11 +922,13 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
                 bits_ag += bits_leaf - bits_route
             else:
                 bits_ag += bits_leaf
-            group_split(dense, leaves, idxs, out_leaves)
-            if use_ef:
-                # EF residual is fp32 by design (see group_split docstring)
-                group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
-                            dtype=jnp.float32)
+            with obs_trace.phase("return"):
+                group_split(dense, leaves, idxs, out_leaves)
+                if use_ef:
+                    # EF residual is fp32 by design (see group_split
+                    # docstring)
+                    group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
+                                dtype=jnp.float32)
             if agree is not None:
                 agrees.append(agree)
             for k, v in leaf_overflows.items():

@@ -183,6 +183,7 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
     * ``overflow`` — this worker's route-clipped count plus this owner's
       return-clipped union count (psum for the global figure).
     """
+    from tpu_compressed_dp.obs import trace as obs_trace
     from tpu_compressed_dp.ops.wire import (_all_gather, _payload_bits,
                                             packed_indices_from_mask)
 
@@ -201,34 +202,38 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
     # keeps its sorted hint.  Clipped/invalid payload slots all target the
     # dump slot W*cap, sliced off before the collective, so their values
     # need no masking.
-    bvals = jnp.zeros((W * cap + 1,) + vals.shape[1:], vals.dtype
-                      ).at[slot].add(vals)[:-1]
-    bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
-                    ).at[slot].set(local)[:-1]
-    bvals = bvals.reshape((W, cap) + vals.shape[1:])
-    bidx = bidx.reshape(W, cap)
-    route_bits = _payload_bits(bvals, bidx)
-    rvals = jax.lax.all_to_all(bvals, axis_name, 0, 0)   # [W, cap(, bs)]
-    ridx = jax.lax.all_to_all(bidx, axis_name, 0, 0)
+    with obs_trace.phase("route"):
+        bvals = jnp.zeros((W * cap + 1,) + vals.shape[1:], vals.dtype
+                          ).at[slot].add(vals)[:-1]
+        bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
+                        ).at[slot].set(local)[:-1]
+        bvals = bvals.reshape((W, cap) + vals.shape[1:])
+        bidx = bidx.reshape(W, cap)
+        route_bits = _payload_bits(bvals, bidx)
+        rvals = jax.lax.all_to_all(bvals, axis_name, 0, 0)   # [W, cap(, bs)]
+        ridx = jax.lax.all_to_all(bidx, axis_name, 0, 0)
 
     # --- owner reduce: W*cap scatter-adds into the dense shard ----------
     # shard_n + 1 rows: the last is the padding guard row, sliced off
-    shard = jnp.zeros((shard_n + 1,) + vals.shape[1:], vals.dtype)
-    occ = jnp.zeros((shard_n + 1,), jnp.int32)
-    if W <= 16:
-        # per-row scatters keep the sorted hint alive (rows are monotone by
-        # construction); same compile-size guard as wire._scatter_combine
-        for w in range(W):
-            shard = shard.at[ridx[w]].add(
-                rvals[w], indices_are_sorted=True, mode="promise_in_bounds")
-            occ = occ.at[ridx[w]].add(
-                1, indices_are_sorted=True, mode="promise_in_bounds")
-    else:
-        flat_i = ridx.reshape(-1)
-        shard = shard.at[flat_i].add(
-            rvals.reshape((-1,) + vals.shape[1:]))
-        occ = occ.at[flat_i].add(1)
-    shard, occ = shard[:shard_n], occ[:shard_n]
+    with obs_trace.phase("reduce"):
+        shard = jnp.zeros((shard_n + 1,) + vals.shape[1:], vals.dtype)
+        occ = jnp.zeros((shard_n + 1,), jnp.int32)
+        if W <= 16:
+            # per-row scatters keep the sorted hint alive (rows are monotone
+            # by construction); same compile-size guard as
+            # wire._scatter_combine
+            for w in range(W):
+                shard = shard.at[ridx[w]].add(
+                    rvals[w], indices_are_sorted=True,
+                    mode="promise_in_bounds")
+                occ = occ.at[ridx[w]].add(
+                    1, indices_are_sorted=True, mode="promise_in_bounds")
+        else:
+            flat_i = ridx.reshape(-1)
+            shard = shard.at[flat_i].add(
+                rvals.reshape((-1,) + vals.shape[1:]))
+            occ = occ.at[flat_i].add(1)
+        shard, occ = shard[:shard_n], occ[:shard_n]
 
     route_overflow = (jnp.sum(valid, dtype=jnp.int32) if valid is not None
                       else jnp.int32(idx.shape[0])
@@ -236,39 +241,41 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
 
     # --- return ---------------------------------------------------------
     if plan.dense_return:
-        g = _all_gather(shard, axis_name)                # [W, shard_n(, bs)]
-        dense = g.reshape((W * shard_n,) + vals.shape[1:])
+        with obs_trace.phase("return"):
+            g = _all_gather(shard, axis_name)            # [W, shard_n(, bs)]
+            dense = g.reshape((W * shard_n,) + vals.shape[1:])
         return_bits = _payload_bits(shard)
         sent = accepted
         overflow = route_overflow
         return dense, sent, route_bits, return_bits, overflow
 
-    cap_ret = plan.cap_ret
-    mask = occ > 0
-    nnz = jnp.sum(mask, dtype=jnp.int32)
-    rix = packed_indices_from_mask(mask, cap_ret)
-    rvalid = jnp.arange(1, cap_ret + 1, dtype=jnp.int32) <= jnp.minimum(
-        nnz, cap_ret)
-    # no sorted hint: when the union underfills cap_ret the pack pads
-    # trailing ranks with index 0, breaking monotonicity
-    sel = shard.at[rix].get(mode="promise_in_bounds")
-    sel = jnp.where(rvalid[(...,) + (None,) * (vals.ndim - 1)], sel, 0)
-    rix = jnp.where(rvalid, rix, 0)
-    return_bits = _payload_bits(sel, rix)
-    g_vals = _all_gather(sel, axis_name)                 # [W, cap_ret(, bs)]
-    g_rix = _all_gather(rix, axis_name)                  # [W, cap_ret]
-    offs = jnp.arange(W, dtype=jnp.int32)[:, None] * shard_n
-    gidx = (g_rix + offs).reshape(-1)
-    dense = jnp.zeros((W * shard_n,) + vals.shape[1:], vals.dtype
-                      ).at[gidx].add(
-                          g_vals.reshape((-1,) + vals.shape[1:]))
-    # Which of MY accepted coordinates actually came back: units the owner
-    # clipped must return to the EF residual (their contributors zeroed
-    # them locally but the synced gradient does not contain them).  No
-    # sorted hint here: zero-padded cap buffers (thresholdv) have index 0
-    # in their tail slots, so ``idx`` is only ascending over its valid
-    # prefix.
-    returned = jnp.zeros((W * shard_n,), jnp.uint8).at[gidx].set(1)
-    sent = accepted & (returned.at[idx].get(mode="promise_in_bounds") > 0)
+    with obs_trace.phase("return"):
+        cap_ret = plan.cap_ret
+        mask = occ > 0
+        nnz = jnp.sum(mask, dtype=jnp.int32)
+        rix = packed_indices_from_mask(mask, cap_ret)
+        rvalid = jnp.arange(1, cap_ret + 1, dtype=jnp.int32) <= jnp.minimum(
+            nnz, cap_ret)
+        # no sorted hint: when the union underfills cap_ret the pack pads
+        # trailing ranks with index 0, breaking monotonicity
+        sel = shard.at[rix].get(mode="promise_in_bounds")
+        sel = jnp.where(rvalid[(...,) + (None,) * (vals.ndim - 1)], sel, 0)
+        rix = jnp.where(rvalid, rix, 0)
+        return_bits = _payload_bits(sel, rix)
+        g_vals = _all_gather(sel, axis_name)             # [W, cap_ret(, bs)]
+        g_rix = _all_gather(rix, axis_name)              # [W, cap_ret]
+        offs = jnp.arange(W, dtype=jnp.int32)[:, None] * shard_n
+        gidx = (g_rix + offs).reshape(-1)
+        dense = jnp.zeros((W * shard_n,) + vals.shape[1:], vals.dtype
+                          ).at[gidx].add(
+                              g_vals.reshape((-1,) + vals.shape[1:]))
+        # Which of MY accepted coordinates actually came back: units the
+        # owner clipped must return to the EF residual (their contributors
+        # zeroed them locally but the synced gradient does not contain
+        # them).  No sorted hint here: zero-padded cap buffers (thresholdv)
+        # have index 0 in their tail slots, so ``idx`` is only ascending
+        # over its valid prefix.
+        returned = jnp.zeros((W * shard_n,), jnp.uint8).at[gidx].set(1)
+        sent = accepted & (returned.at[idx].get(mode="promise_in_bounds") > 0)
     overflow = route_overflow + jnp.maximum(nnz - cap_ret, 0)
     return dense, sent, route_bits, return_bits, overflow

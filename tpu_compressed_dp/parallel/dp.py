@@ -56,6 +56,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from tpu_compressed_dp.obs import registry as obs_registry
+from tpu_compressed_dp.obs import trace as obs_trace
 from tpu_compressed_dp.ops import compressors, kernels
 
 __all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
@@ -167,10 +169,17 @@ def make_sharded_clip(is_sharded, shard_axis):
 # Stats that are 0/1 diagnostics, identical across ranks (or min/max
 # verdicts), NOT additive volumes: the partitioned sync must not psum them
 # over model axes or sum them across signature groups.  Maps key -> the
-# (cross-rank collective, cross-group combiner) pair.
+# (cross-rank collective, cross-group combiner) pair.  Derived from the
+# metric registry's declared reductions (obs/registry.py) so the engine's
+# diagnostic table can never silently disagree with the declarations the
+# conformance test enforces.
+_DIAG_COLLECTIVES = {
+    "min": (jax.lax.pmin, jnp.minimum),
+    "max": (jax.lax.pmax, jnp.maximum),
+}
 _DIAG_STATS = {
-    "sync_agree": (jax.lax.pmin, jnp.minimum),
-    "guard/nonfinite": (jax.lax.pmax, jnp.maximum),
+    key: _DIAG_COLLECTIVES[red]
+    for key, red in obs_registry.engine_diag_reductions().items()
 }
 
 
@@ -627,31 +636,35 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         dense_total = 0.0
         for gi, idxs in enumerate(groups):
             flat = group_concat(leaves, idxs)
-            acc = flat + group_concat(ef_leaves, idxs) if use_ef else flat
+            with obs_trace.phase("ef"):
+                acc = flat + group_concat(ef_leaves, idxs) if use_ef else flat
             n_g = flat.shape[0]
-            if (comp.name == "topk" and acc.dtype == jnp.float32
-                    and kernels.use_fused_sparsify(n_g)):
-                # fused epilogue: threshold-mask + compress + residual +
-                # nonzero count in ONE pass over the accumulated gradient
-                # (pallas_call boundaries block XLA from fusing the
-                # where/subtract/count chain around the threshold kernel).
-                # fp32-gated so the psum payload dtype matches the unfused
-                # path.
-                keep = compressors.topk_keep_count(n_g, cfg.ratio)
-                t = kernels.topk_threshold(jnp.abs(acc), keep)
-                comp_flat, new_ef_flat, group_sent = kernels.fused_sparsify(
-                    acc, t, want_ef=use_ef)
-                group_bits = group_sent * bits_per_elem
-            else:
-                comp_flat = compress_flat(acc, key, gi)
-                new_ef_flat = acc - comp_flat if use_ef else None
-                group_sent = sent_count(comp_flat)
-                group_bits = sent_bits(comp_flat, group_sent)
-            reduced = jax.lax.psum(comp_flat, axis_name) / world
-            group_split(reduced, leaves, idxs, out_leaves)
-            if use_ef:
-                group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
-                            dtype=jnp.float32)
+            with obs_trace.phase("compress"):
+                if (comp.name == "topk" and acc.dtype == jnp.float32
+                        and kernels.use_fused_sparsify(n_g)):
+                    # fused epilogue: threshold-mask + compress + residual +
+                    # nonzero count in ONE pass over the accumulated gradient
+                    # (pallas_call boundaries block XLA from fusing the
+                    # where/subtract/count chain around the threshold kernel).
+                    # fp32-gated so the psum payload dtype matches the unfused
+                    # path.
+                    keep = compressors.topk_keep_count(n_g, cfg.ratio)
+                    t = kernels.topk_threshold(jnp.abs(acc), keep)
+                    comp_flat, new_ef_flat, group_sent = kernels.fused_sparsify(
+                        acc, t, want_ef=use_ef)
+                    group_bits = group_sent * bits_per_elem
+                else:
+                    comp_flat = compress_flat(acc, key, gi)
+                    new_ef_flat = acc - comp_flat if use_ef else None
+                    group_sent = sent_count(comp_flat)
+                    group_bits = sent_bits(comp_flat, group_sent)
+            with obs_trace.phase("reduce"):
+                reduced = jax.lax.psum(comp_flat, axis_name) / world
+            with obs_trace.phase("return"):
+                group_split(reduced, leaves, idxs, out_leaves)
+                if use_ef:
+                    group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
+                                dtype=jnp.float32)
             transport = wire_transport(comp.name, n_g, cfg)
             if transport == "sharded" and world > 1:
                 # counterfactual like the rest of simulate billing: bill the
@@ -740,12 +753,14 @@ def _make_powersgd_sync(cfg: CompressionConfig, axis_name):
         agrees = []
         for gi, idxs in enumerate(groups):
             flat = group_concat(leaves, idxs)
-            acc = flat + group_concat(ef_leaves, idxs) if use_ef else flat
-            acc = acc.astype(jnp.float32)
+            with obs_trace.phase("ef"):
+                acc = flat + group_concat(ef_leaves, idxs) if use_ef else flat
+                acc = acc.astype(jnp.float32)
             n_g = flat.shape[0]
             if lowrank.powersgd_dims(n_g, cfg.rank) is None:
                 # factors would cost >= the dense vector: psum dense (exact)
-                recon = jax.lax.psum(acc, axis_name) / world
+                with obs_trace.phase("reduce"):
+                    recon = jax.lax.psum(acc, axis_name) / world
                 new_ef_flat = jnp.zeros_like(acc) if use_ef else None
                 group_sent, group_bits = float(n_g), 32.0 * n_g
                 n_coll += 1
@@ -767,16 +782,22 @@ def _make_powersgd_sync(cfg: CompressionConfig, axis_name):
                               - jax.lax.pmin(q_in, axis_name))
                     agrees.append(
                         (jnp.max(jnp.abs(spread)) == 0.0).astype(jnp.float32))
-                recon, q_new, group_sent, group_bits = (
-                    lowrank.powersgd_group_sync(
-                        acc, q_in, cfg.rank, axis_name, world))
+                # the low-rank factor iteration interleaves compression
+                # (matmuls against Q) with its two psums — one scope covers
+                # the compress+reduce pair (xprof still splits the psums out
+                # by op name inside it)
+                with obs_trace.phase("compress"):
+                    recon, q_new, group_sent, group_bits = (
+                        lowrank.powersgd_group_sync(
+                            acc, q_in, cfg.rank, axis_name, world))
                 new_comp[qk] = q_new
                 new_ef_flat = acc - recon if use_ef else None
                 n_coll += 2  # P-psum + Q-psum
-            group_split(recon, leaves, idxs, out_leaves)
-            if use_ef:
-                group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
-                            dtype=jnp.float32)
+            with obs_trace.phase("return"):
+                group_split(recon, leaves, idxs, out_leaves)
+                if use_ef:
+                    group_split(new_ef_flat, leaves, idxs, new_ef_leaves,
+                                dtype=jnp.float32)
             sent_total += group_sent
             bits_total += group_bits
             dense_total += float(n_g)

@@ -43,6 +43,7 @@ from tpu_compressed_dp.models.transformer import (
     use_fused_head_xent,
     vocab_parallel_xent,
 )
+from tpu_compressed_dp.obs import trace as obs_trace
 from tpu_compressed_dp.parallel.dp import (
     CompressionConfig,
     make_grouped_grad_sync,
@@ -239,7 +240,9 @@ def make_lm_train_step(
         varying = jax.tree.map(
             lambda p: compat.pcast(p, sync_axes, to="varying"), state.params
         )
-        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying)
+        with obs_trace.phase("grad"):
+            (_, loss), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(varying)
         if inject:
             loss, grads = chaos_mod.inject(
                 chaos, state.step, guard_mod.worker_index(sync_axes), loss,
@@ -264,8 +267,9 @@ def make_lm_train_step(
             synced = clip_tree(synced, clip_sent_norm)
 
         new_step = state.step + 1
-        new_params, new_opt = optimizer.apply(state.params, synced,
-                                              state.opt_state, new_step)
+        with obs_trace.phase("update"):
+            new_params, new_opt = optimizer.apply(state.params, synced,
+                                                  state.opt_state, new_step)
         new_guard = state.guard
         if guarded:
             new_params = guard_mod.select_tree(ok, new_params, state.params)

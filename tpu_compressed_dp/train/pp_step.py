@@ -55,6 +55,7 @@ from tpu_compressed_dp.models.transformer import (
     use_fused_head_xent,
     vocab_parallel_xent,
 )
+from tpu_compressed_dp.obs import trace as obs_trace
 from tpu_compressed_dp.ops.ring_attention import ring_attention
 from tpu_compressed_dp.parallel.dp import (
     CompressionConfig,
@@ -379,7 +380,8 @@ def make_pp_train_step(
         varying = jax.tree.map(
             lambda p: compat.pcast(p, sync_axes, to="varying"), state.params
         )
-        loss, grads = jax.value_and_grad(loss_fn)(varying)
+        with obs_trace.phase("grad"):
+            loss, grads = jax.value_and_grad(loss_fn)(varying)
         loss = loss / ls_scale  # raw loss for metrics/vote (1.0 unguarded)
         if inject:
             loss, grads = chaos_mod.inject(
@@ -406,8 +408,9 @@ def make_pp_train_step(
             synced = clip_tree(synced, clip_sent_norm)
 
         new_step = state.step + 1
-        new_params, new_opt = optimizer.apply(state.params, synced,
-                                              state.opt_state, new_step)
+        with obs_trace.phase("update"):
+            new_params, new_opt = optimizer.apply(state.params, synced,
+                                                  state.opt_state, new_step)
         new_guard = state.guard
         if guarded:
             new_params = guard_mod.select_tree(ok, new_params, state.params)

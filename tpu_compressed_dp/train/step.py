@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpu_compressed_dp import compat
 from tpu_compressed_dp.compat import shard_map
 
+from tpu_compressed_dp.obs import trace as obs_trace
 from tpu_compressed_dp.parallel.dp import CompressionConfig, make_grad_sync
 from tpu_compressed_dp.train import guard as guard_mod
 from tpu_compressed_dp.train.guard import GuardConfig
@@ -145,7 +146,9 @@ def make_train_step(
         # device-varying so jax.grad yields the per-worker local gradient and
         # the (possibly compressed) psum stays under our control in grad_sync.
         varying_params = jax.tree.map(lambda p: _to_varying(p, axis_name), state.params)
-        (_, (new_bs, logits, loss)), grads = jax.value_and_grad(loss_fn, has_aux=True)(varying_params)
+        with obs_trace.phase("grad"):
+            (_, (new_bs, logits, loss)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(varying_params)
 
         scaled = jax.tree.map(lambda g: g.astype(jnp.float32) * grad_scale, grads)
         if inject:
@@ -183,7 +186,9 @@ def make_train_step(
             synced = jax.tree.map(lambda g: g * sfactor, synced)
 
         new_step = state.step + 1
-        new_params, new_opt = optimizer.apply(state.params, synced, state.opt_state, new_step)
+        with obs_trace.phase("update"):
+            new_params, new_opt = optimizer.apply(
+                state.params, synced, state.opt_state, new_step)
 
         # BN running stats are computed from the local shard; average them so
         # the replicated state stays consistent.  Normalisation itself still

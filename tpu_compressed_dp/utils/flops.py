@@ -33,6 +33,8 @@ __all__ = [
     "transformer_train_flops_per_token",
     "chip_peak_flops",
     "mfu",
+    "throughput_record",
+    "cnn_mfu_record",
     "PEAK_FLOPS_BF16",
 ]
 
@@ -114,6 +116,33 @@ def mfu(model_flops_per_sec: float, device=None) -> Optional[float]:
     if not peak or model_flops_per_sec <= 0:
         return None
     return model_flops_per_sec / peak
+
+
+def throughput_record(fwd_flops: Optional[float], steps_per_sec: float,
+                      *, examples_per_sec: Optional[float] = None,
+                      tokens_per_sec: Optional[float] = None
+                      ) -> Dict[str, float]:
+    """The registry-named throughput/MFU telemetry for one window.
+
+    ``fwd_flops`` is the PER-CHIP forward cost of one step (from
+    :func:`fwd_flops_xla` at the per-chip batch shape, or a closed form
+    divided by chip count); shared by all three harness epilogues so
+    examples/s, tokens/s, TFLOP/s-per-chip and MFU are computed the same
+    way everywhere.  MFU is omitted off-TPU (unknown peak), TFLOPs when the
+    backend exposes no cost model."""
+    rec: Dict[str, float] = {}
+    if examples_per_sec is not None:
+        rec["throughput/examples_per_sec"] = examples_per_sec
+    if tokens_per_sec is not None:
+        rec["throughput/tokens_per_sec"] = tokens_per_sec
+    if fwd_flops is None or steps_per_sec <= 0:
+        return rec
+    per_chip = train_flops_per_step(fwd_flops) * steps_per_sec
+    rec["throughput/model_tflops_per_chip"] = per_chip / 1e12
+    u = mfu(per_chip)
+    if u is not None:
+        rec["throughput/mfu"] = u
+    return rec
 
 
 def cnn_mfu_record(apply_fn, params, batch_stats, input_shape,

@@ -18,6 +18,8 @@ import os
 import sys
 from typing import Dict, Iterable, List, Optional
 
+from tpu_compressed_dp.obs import registry as obs_registry
+
 __all__ = [
     "TableLogger",
     "TSVLogger",
@@ -184,9 +186,12 @@ class MetricAccumulator:
     pure host-side bookkeeping.
     """
 
-    #: keys that are global sums per step (everything else is a per-example or
-    #: per-step value, averaged with the step's example count as weight)
-    SUM_KEYS = frozenset({"correct", "correct5", "count", "loss_sum"})
+    #: keys that are global sums per step (everything else is a per-example
+    #: or per-step value, averaged with the step's example count as weight).
+    #: Derived from the metric registry's declared reductions
+    #: (obs/registry.py) — a new sum-reduced metric joins automatically.
+    SUM_KEYS = frozenset(name for name, ms in obs_registry.REGISTRY.items()
+                         if ms.reduction == "sum")
 
     def __init__(self):
         self.sums: Dict[str, float] = {}
@@ -195,8 +200,11 @@ class MetricAccumulator:
         #: (guard/skipped totals, guard/loss_scale) where a weighted mean is
         #: meaningless and the end-of-epoch value is the honest summary
         self.last: Dict[str, float] = {}
+        #: update() calls seen — the step count rate math needs
+        self.steps: int = 0
 
     def update(self, metrics: Dict[str, float]) -> None:
+        self.steps += 1
         w = float(metrics.get("count", 1.0))
         for k, v in metrics.items():
             v = float(v)

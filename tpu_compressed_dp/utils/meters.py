@@ -21,7 +21,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 __all__ = ["TimeMeter", "NetworkMeter", "CommMeter", "GuardMeter",
-           "network_bytes", "per_chip_traffic_bytes"]
+           "network_bytes", "per_chip_traffic_bytes", "per_chip_comm_bytes"]
 
 
 def per_chip_traffic_bytes(psum_bytes: float, allgather_bytes: float,
@@ -45,6 +45,21 @@ def per_chip_traffic_bytes(psum_bytes: float, allgather_bytes: float,
     ring = 2 * (world - 1) / max(world, 1)
     return (ring * psum_bytes + (world - 1) * allgather_bytes
             + (world - 1) / max(world, 1) * alltoall_bytes)
+
+
+def per_chip_comm_bytes(m: Dict[str, float], world: int) -> Optional[float]:
+    """Per-chip link bytes of ONE step from a ``comm/*`` metrics dict
+    (per-step values or epoch means), applying the transport split through
+    :func:`per_chip_traffic_bytes`.  None when comm metrics are absent
+    (compression off).  The single epilogue all three harnesses use for
+    their comm-bytes/s column, so they can never disagree on the
+    arithmetic."""
+    if "comm/sent_bits" not in m:
+        return None
+    psum_b = float(m.get("comm/sent_bits_psum", m["comm/sent_bits"])) / 8
+    ag_b = float(m.get("comm/sent_bits_allgather", 0.0)) / 8
+    a2a_b = float(m.get("comm/sent_bits_alltoall", 0.0)) / 8
+    return per_chip_traffic_bytes(psum_b, ag_b, world, a2a_b)
 
 
 class TimeMeter:

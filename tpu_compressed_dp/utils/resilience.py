@@ -24,7 +24,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
-__all__ = ["Heartbeat", "read_heartbeat", "is_stale", "run_with_recovery"]
+__all__ = ["Heartbeat", "read_heartbeat", "is_stale", "check_heartbeat",
+           "run_with_recovery"]
 
 
 class Heartbeat:
@@ -95,6 +96,63 @@ def is_stale(path: str, max_age_s: float) -> bool:
     """True when the heartbeat is missing or older than ``max_age_s``."""
     hb = read_heartbeat(path)
     return hb is None or (time.time() - hb["ts"]) > max_age_s
+
+
+def check_heartbeat(path: str, *, max_age_s: float = 60.0,
+                    max_wedge_steps: Optional[int] = None,
+                    min_steps_per_sec: Optional[float] = None,
+                    now: Optional[float] = None,
+                    hb: Optional[Dict[str, Any]] = None) -> list:
+    """Health-check a heartbeat file; returns a list of problem strings
+    (empty = healthy) — the check-only half of the ROADMAP watchdog,
+    consumed by ``tools/watchdog.py --check``.
+
+    Three independent failure modes, each reading a different part of the
+    payload the harnesses write:
+
+    * **dead/stale** — file missing, unreadable, or ``ts`` older than
+      ``max_age_s``: the writer thread (and so the process) is gone.
+    * **wedged** — the process is alive and ``step`` advances, but
+      ``last_good_step`` (the step-guard's applied-update watermark) has
+      fallen more than ``max_wedge_steps`` behind: every step is being
+      vetoed — exactly the wedge a liveness check alone cannot see.
+    * **stalled** — the telemetry snapshot's ``steps_per_sec`` (from the
+      :class:`~tpu_compressed_dp.obs.trace.StepTimeline` window) has
+      dropped below ``min_steps_per_sec``: alive, applying updates, but
+      crawling (data stall, thrashing input pipeline).
+
+    Wedge/stall checks are skipped when their payload fields are absent
+    (guard/telemetry off) — absence of optional telemetry is not a fault.
+    Pass ``hb`` (an already-parsed record) to check a single consistent
+    read — callers that also inspect the payload should read once and
+    share it, not race a concurrent ``os.replace`` between two reads.
+    """
+    now = time.time() if now is None else now
+    if hb is None:
+        hb = read_heartbeat(path)
+    if hb is None:
+        return [f"heartbeat missing or unreadable: {path}"]
+    problems = []
+    age = now - float(hb.get("ts", 0.0))
+    if age > max_age_s:
+        problems.append(
+            f"stale: heartbeat is {age:.1f}s old (> {max_age_s:g}s) — "
+            "worker dead or hung")
+    if max_wedge_steps is not None and "last_good_step" in hb:
+        lag = int(hb.get("step", 0)) - int(hb["last_good_step"])
+        if lag > max_wedge_steps:
+            problems.append(
+                f"wedged: last applied update is {lag} steps behind the "
+                f"attempt counter (> {max_wedge_steps}) — every step is "
+                "being skipped")
+    tele = hb.get("telemetry") or {}
+    if (min_steps_per_sec is not None
+            and tele.get("steps_per_sec") is not None
+            and float(tele["steps_per_sec"]) < min_steps_per_sec):
+        problems.append(
+            f"stalled: step rate {float(tele['steps_per_sec']):.4g}/s "
+            f"below the {min_steps_per_sec:g}/s floor")
+    return problems
 
 
 def run_with_recovery(
