@@ -139,7 +139,7 @@ class TestGuardMeter:
 # -------------------------------------------------- jitted-step integration
 
 def _build(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9,
-           dtype=jnp.float32):
+           dtype=jnp.float32, lr=0.05):
     import flax.linen as nn
 
     from tpu_compressed_dp.models.common import init_model, make_apply_fn
@@ -154,7 +154,7 @@ def _build(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9,
     module = TinyMLP()
     params, stats = init_model(module, jax.random.key(0),
                                jnp.zeros((1, 4, 4, 3), jnp.float32))
-    opt = SGD(lr=0.05, momentum=momentum, nesterov=momentum > 0)
+    opt = SGD(lr=lr, momentum=momentum, nesterov=momentum > 0)
     n = mesh.shape["data"]
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp_cfg, n),
@@ -196,6 +196,60 @@ def test_single_worker_nan_vetoes_globally_and_holds_state(mesh8):
     assert float(m["guard/nonfinite"]) == 0.0
     assert float(m["guard/skip_streak"]) == 0.0
     assert int(state.step) == 3
+
+
+def test_schedule_step_unit():
+    """schedule_step: applied-update count under rewind; raw passthrough
+    when the guard is off or the knob is."""
+    from tpu_compressed_dp.train.guard import schedule_step
+
+    cfg = GuardConfig(loss_scaling=False)
+    gs = init_guard_state(cfg)
+    gs = dataclasses.replace(gs, total_skipped=jnp.asarray(4, jnp.int32))
+    assert int(schedule_step(cfg, gs, jnp.asarray(10, jnp.int32))) == 6
+    off = GuardConfig(loss_scaling=False, lr_rewind=False)
+    assert int(schedule_step(off, gs, jnp.asarray(10, jnp.int32))) == 10
+    assert int(schedule_step(None, (), jnp.asarray(10, jnp.int32))) == 10
+    assert int(schedule_step(cfg, (), jnp.asarray(10, jnp.int32))) == 10
+
+
+def test_lr_rewind_skips_dont_advance_schedule(mesh8):
+    """The ROADMAP item's acceptance: N injected-NaN skips leave the LR —
+    and, with a deterministic compressor + fixed batch, the ENTIRE applied
+    trajectory (params/opt/ef) — exactly where an unskipped run of the same
+    good-step count puts them.  Without rewind the vetoed attempts
+    fast-forward the schedule clock and the LRs diverge."""
+    lr_sched = lambda s: 0.05 / (1.0 + 0.5 * s.astype(jnp.float32))  # noqa: E731
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True)
+    gcfg = GuardConfig(loss_scaling=False)
+    batch = _batch()
+
+    # run A: 5 attempts, NaN injected at raw steps 1 and 3 -> 3 applied
+    chaos = ChaosConfig(kind="nan", target="grads", steps=(1, 3), worker=2)
+    sA, stepA = _build(mesh8, comp, gcfg, chaos, lr=lr_sched)
+    for _ in range(5):
+        sA, mA = stepA(sA, batch)
+    assert float(mA["guard/skipped"]) == 2.0
+    assert int(sA.step) == 5
+
+    # run B: 3 clean attempts -> the same 3 applied updates
+    sB, stepB = _build(mesh8, comp, gcfg, None, lr=lr_sched)
+    for _ in range(3):
+        sB, mB = stepB(sB, batch)
+
+    assert float(mA["lr"]) == float(mB["lr"])  # schedule clock identical
+    for a, b in zip(jax.tree.leaves((sA.params, sA.opt_state, sA.ef)),
+                    jax.tree.leaves((sB.params, sB.opt_state, sB.ef))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    # control arm: rewind off -> the 2 vetoed attempts advance the clock
+    sC, stepC = _build(mesh8, comp,
+                       GuardConfig(loss_scaling=False, lr_rewind=False),
+                       chaos, lr=lr_sched)
+    for _ in range(5):
+        sC, mC = stepC(sC, batch)
+    assert float(mC["lr"]) == pytest.approx(float(lr_sched(jnp.asarray(5.0))))
+    assert float(mC["lr"]) != float(mB["lr"])
 
 
 def test_guard_off_matches_guard_on_fp32(mesh8):

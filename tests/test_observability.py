@@ -360,6 +360,107 @@ class TestWatchdog:
 
 
 @pytest.mark.quick
+class TestWatchdogRelaunch:
+    """The relaunch decision loop (tools/watchdog.py supervise) against a
+    fake child and a scripted heartbeat-verdict sequence: restart on
+    wedge/death with doubling backoff, budget refilled by a healthy check,
+    give-up after max_relaunches CONSECUTIVE restarts, clean exit ends
+    supervision."""
+
+    class _Child:
+        def __init__(self, rc=None):
+            self.rc = rc  # None = still running
+
+        def poll(self):
+            return self.rc
+
+        @property
+        def returncode(self):
+            return self.rc
+
+    def _drive(self, verdicts, *, max_relaunches=2, grace=0.0, interval=1.0,
+               child_rcs=(), max_checks=None):
+        import tools.watchdog as wd
+
+        spawned, killed, sleeps = [], [], []
+
+        def spawn():
+            rc = (child_rcs[len(spawned)] if len(spawned) < len(child_rcs)
+                  else None)
+            c = self._Child(rc)
+            spawned.append(c)
+            return c
+
+        it = iter(verdicts)
+        rc = wd.supervise(
+            spawn, lambda: next(it),
+            interval_s=interval, grace_s=grace,
+            max_relaunches=max_relaunches, backoff_s=5.0, backoff_cap_s=40.0,
+            sleep=sleeps.append, kill=lambda c, **k: killed.append(c),
+            log=lambda m: None, max_checks=max_checks)
+        return rc, spawned, killed, sleeps
+
+    def test_clean_exit_ends_supervision(self):
+        rc, spawned, killed, _ = self._drive([], child_rcs=[0])
+        assert rc == 0 and len(spawned) == 1 and killed == []
+
+    def test_wedge_relaunches_with_doubling_backoff_then_gives_up(self):
+        rc, spawned, killed, sleeps = self._drive([1, 1, 1], max_relaunches=2)
+        assert rc == 1  # wedged (alive) children report generic failure
+        assert len(spawned) == 1 + 2  # initial + both budgeted relaunches
+        assert len(killed) == 3  # 2 relaunch kills + the give-up kill
+        # sleep trace: tick, backoff 5, tick, backoff 10 (doubled), tick
+        assert sleeps == [1.0, 5.0, 1.0, 10.0, 1.0]
+
+    def test_dead_childs_exit_code_propagates_on_give_up(self):
+        rc, spawned, _, _ = self._drive([1], max_relaunches=0, child_rcs=[7])
+        assert rc == 7 and len(spawned) == 1
+
+    def test_healthy_check_refills_budget_and_resets_backoff(self):
+        rc, spawned, _, sleeps = self._drive([1, 0, 1], max_relaunches=2,
+                                             max_checks=3)
+        assert rc == 0  # bounded by max_checks, never gave up
+        assert len(spawned) == 3
+        backoffs = [s for s in sleeps if s != 1.0]
+        assert backoffs == [5.0, 5.0]  # second wedge backs off from the base
+
+    def test_grace_period_suppresses_checks_after_each_launch(self):
+        rc, _, _, sleeps = self._drive([0, 0], grace=2.5, max_checks=2)
+        assert rc == 0
+        # 2 silent warm-up ticks before the 1st check, then 2 checked ticks
+        assert sleeps == [1.0, 1.0, 1.0, 1.0]
+
+    def test_cli_requires_command(self, capsys):
+        import tools.watchdog as wd
+
+        assert wd.main(["--relaunch", "--heartbeat", "hb.json"]) == 2
+        assert "training command" in capsys.readouterr().out
+
+    def test_exception_kills_child_not_orphans(self):
+        """Ctrl-C (or a check() crash) mid-supervision must kill the child
+        on the way out — a detached run would keep refreshing the
+        heartbeat under a restarted watchdog's feet."""
+        import tools.watchdog as wd
+
+        spawned, killed = [], []
+
+        def spawn():
+            c = self._Child(None)
+            spawned.append(c)
+            return c
+
+        def check():
+            raise KeyboardInterrupt
+
+        with pytest.raises(KeyboardInterrupt):
+            wd.supervise(spawn, check, interval_s=1.0, grace_s=0.0,
+                         max_relaunches=2, sleep=lambda s: None,
+                         kill=lambda c, **k: killed.append(c),
+                         log=lambda m: None)
+        assert killed == spawned  # the (only) child was cleaned up
+
+
+@pytest.mark.quick
 class TestTraceReport:
     def _events(self, tmp_path):
         p = str(tmp_path / "ev.jsonl")
@@ -402,6 +503,33 @@ class TestTraceReport:
 
         with pytest.raises(ValueError, match="schema version"):
             tr.check_schema([{"v": 999, "kind": "epoch"}])
+
+    def test_schedule_section(self, tmp_path, capsys):
+        """--schedule folds the overlap_evidence per-chunk placement table
+        into the report (the device-side overlap view the host timeline
+        cannot carry)."""
+        import tools.trace_report as tr
+
+        sched = tmp_path / "overlap.txt"
+        sched.write_text(
+            "# header comment\n"
+            "== topk1%-EF-bucketed4MB-overlap4: 4 collective instr ==\n"
+            "   all-reduce     chunk=c00  operands=  1 ~    9.44 MB  "
+            "compute_after=  70 ( 60.0%)\n"
+            "   summary: first=60.0% mean=45.0% last=20.0%\n")
+        out = tr.render_schedule(str(sched))
+        assert "chunk=c00" in out and "summary: first=60.0%" in out
+        assert "# header comment" not in out
+        assert tr.main([self._events(tmp_path),
+                        "--schedule", str(sched)]) == 0
+        assert "compiled-schedule overlap" in capsys.readouterr().out
+        # --json must carry the schedule too, not silently drop the flag
+        assert tr.main([self._events(tmp_path), "--json",
+                        "--schedule", str(sched)]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert any("chunk=c00" in ln for ln in payload["schedule"])
+        missing = tr.render_schedule(str(tmp_path / "nope.txt"))
+        assert "unreadable" in missing
 
 
 @pytest.mark.quick
@@ -503,7 +631,12 @@ class TestMeters:
         assert out["net/allreduce_gbps_per_chip"] > 0
 
 
+@pytest.mark.slow
 def test_imagenet_harness_tensorboard_integration(tmp_path):
+    # full imagenet-harness run (~60 s CPU): the tensorboard/event-stream
+    # surface it exercises end-to-end stays tier-1-covered by the dawn/LM
+    # e2e runs and the TestTraceReport/TestEventStream units; slow-marked
+    # so tier-1 keeps headroom under its 870 s budget
     from tpu_compressed_dp.harness import imagenet as h
 
     ev_path = str(tmp_path / "events.jsonl")

@@ -165,6 +165,24 @@ def render_report(events: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_schedule(path: str) -> str:
+    """Render the per-chunk collective placement recorded by
+    ``tools/overlap_evidence.py`` (``benchmarks/overlap_hlo_r8.txt``)
+    alongside the host report: which ``tcdp.chunk<ii>`` collective sits
+    where in the compiled schedule, and how much model compute remains to
+    hide it — the overlap, directly.  The host timeline cannot see device
+    phases; the AOT schedule artifact is the device-side view."""
+    lines = ["", f"compiled-schedule overlap ({path}):"]
+    try:
+        txt = open(path).read()
+    except OSError as e:
+        return "\n".join(lines + [f"  (unreadable: {e})"])
+    for ln in txt.splitlines():
+        if ln.startswith("== ") or "chunk=" in ln or "summary:" in ln:
+            lines.append("  " + ln.strip())
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("events", help="JSONL event stream (harness --events)")
@@ -172,13 +190,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="write a chrome://tracing trace-event JSON here")
     p.add_argument("--json", action="store_true",
                    help="emit the breakdown/trajectory as JSON instead of text")
+    p.add_argument("--schedule", type=str, default=None,
+                   help="also render the per-chunk collective placement "
+                        "from an overlap_evidence output file "
+                        "(benchmarks/overlap_hlo_r8.txt)")
     args = p.parse_args(argv)
     events = read_events(args.events)
     if args.json:
-        print(json.dumps({"phase_breakdown": phase_breakdown(events),
-                          "throughput": throughput_rows(events)}, indent=2))
+        payload = {"phase_breakdown": phase_breakdown(events),
+                   "throughput": throughput_rows(events)}
+        if args.schedule:
+            payload["schedule"] = render_schedule(args.schedule).splitlines()
+        print(json.dumps(payload, indent=2))
     else:
         print(render_report(events))
+        if args.schedule:
+            print(render_schedule(args.schedule))
     if args.chrome:
         with open(args.chrome, "w") as f:
             json.dump({"traceEvents": chrome_trace_events(events),

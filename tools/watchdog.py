@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""Heartbeat watchdog — the check-only half of the ROADMAP watchdog item.
+"""Heartbeat watchdog — check and (now) relaunch halves of the ROADMAP
+watchdog item.
 
-Reads the liveness file a harness writes under ``--heartbeat`` (payload:
-``ts``, ``step``, ``last_good_step``, and the telemetry snapshot the
-observability layer added — step rate, p95 step latency) and exits nonzero
-when the run is unhealthy, so a cron job / systemd timer / supervisor can
-alert or relaunch:
+``--check`` reads the liveness file a harness writes under ``--heartbeat``
+(payload: ``ts``, ``step``, ``last_good_step``, and the telemetry snapshot
+the observability layer added — step rate, p95 step latency) and exits
+nonzero when the run is unhealthy, so a cron job / systemd timer /
+supervisor can alert or relaunch:
 
   exit 0  healthy
   exit 1  unhealthy (stale / wedged / stalled; reasons on stdout)
@@ -20,21 +21,35 @@ Checks (see :func:`tpu_compressed_dp.utils.resilience.check_heartbeat`):
   * **stalled** — telemetry ``steps_per_sec`` below ``--min_step_rate``:
     alive and applying updates, but crawling.
 
+``--relaunch`` is the acting half: it supervises the training command given
+after ``--``, runs the SAME health check every ``--interval`` seconds
+(after a ``--grace`` warm-up so the first heartbeat can appear), and on an
+unhealthy/missing verdict kills the child (if still alive — a wedged run is
+alive but useless), waits out a capped exponential backoff, and respawns.
+A healthy check resets the backoff; a clean child exit (rc 0) ends
+supervision; after ``--max_relaunches`` restarts it gives up with the
+child's last exit code (or 1).  The restart budget is CONSECUTIVE — any
+healthy check refills it — so a long-lived run that crashes once a day is
+not eventually abandoned.
+
 Usage::
 
     python tools/watchdog.py --check --heartbeat /path/hb.json
     python tools/watchdog.py --check --heartbeat hb.json \\
         --max_age 120 --max_wedge 200 --min_step_rate 0.01
-
-The auto-relaunch half (acting on this exit code) remains a ROADMAP open
-item; this tool deliberately only observes.
+    python tools/watchdog.py --relaunch --heartbeat hb.json \\
+        --interval 30 --grace 120 --max_relaunches 5 -- \\
+        python -m tpu_compressed_dp.harness.dawn --synthetic --guard \\
+            --heartbeat hb.json
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
 
 from tpu_compressed_dp.utils.resilience import check_heartbeat, read_heartbeat
 
@@ -67,11 +82,122 @@ def run_check(args) -> int:
     return 0
 
 
+def kill_child(child, term_timeout_s: float = 10.0) -> None:
+    """Terminate a (possibly wedged) child: SIGTERM, bounded wait, SIGKILL.
+    A no-op when the child already exited."""
+    if child.poll() is not None:
+        return
+    child.terminate()
+    try:
+        child.wait(timeout=term_timeout_s)
+    except Exception:
+        child.kill()
+        child.wait()
+
+
+def supervise(spawn: Callable[[], "subprocess.Popen"],
+              check: Callable[[], int],
+              *,
+              interval_s: float,
+              grace_s: float,
+              max_relaunches: int,
+              backoff_s: float = 5.0,
+              backoff_cap_s: float = 300.0,
+              sleep: Callable[[float], None] = time.sleep,
+              kill: Callable[..., None] = kill_child,
+              log: Callable[[str], None] = print,
+              max_checks: Optional[int] = None) -> int:
+    """The relaunch decision loop, with every side effect injectable so the
+    unit test can drive it against a fake child and a scripted check
+    sequence (tests/test_observability.py::TestWatchdogRelaunch).
+
+    Protocol per tick: sleep ``interval_s``; a child that exited cleanly
+    (rc 0) ends supervision with 0; otherwise consult ``check`` (the
+    heartbeat verdict — 0 healthy / 1 unhealthy / 2 missing).  Healthy
+    resets the consecutive-restart counter (and so the backoff).  Unhealthy
+    or missing: if the consecutive budget is spent, give up (child's exit
+    code, else 1); otherwise kill whatever is left of the child, back off
+    ``backoff_s * 2^consecutive`` capped at ``backoff_cap_s``, respawn, and
+    re-enter the grace period (no checks for ``grace_s`` — a fresh process
+    needs time to write its first heartbeat).
+    """
+    child = spawn()
+    consecutive = 0
+    grace_until = grace_s  # relative clock: ticks since (re)launch
+    ticks_since_launch = 0.0
+    checks = 0
+    try:
+        while True:
+            sleep(interval_s)
+            ticks_since_launch += interval_s
+            if child.poll() is not None and child.returncode == 0:
+                log("watchdog: child exited cleanly; supervision done")
+                return 0
+            if ticks_since_launch < grace_until:
+                continue  # fresh (re)launch: let the heartbeat appear
+            rc = check()
+            checks += 1
+            if rc == 0:
+                consecutive = 0
+            else:
+                if consecutive >= max_relaunches:
+                    died_rc = child.poll()
+                    kill(child)
+                    # a positive rc is the child's own failure code;
+                    # killed-by-us (negative) or alive-but-wedged reports 1
+                    code = (died_rc if died_rc is not None and died_rc > 0
+                            else 1)
+                    log(f"watchdog: giving up after {consecutive} "
+                        f"consecutive relaunches (exit {code})")
+                    return int(code)
+                delay = min(backoff_s * (2.0 ** consecutive), backoff_cap_s)
+                log(f"watchdog: unhealthy (check rc={rc}); relaunch "
+                    f"#{consecutive + 1}/{max_relaunches} after {delay:.0f}s "
+                    "backoff")
+                kill(child)
+                sleep(delay)
+                child = spawn()
+                consecutive += 1
+                ticks_since_launch = 0.0
+            if max_checks is not None and checks >= max_checks:
+                # test hook: bounded supervision
+                kill(child)
+                return 0
+    except BaseException:
+        # Ctrl-C or an unexpected check/spawn error must not orphan the
+        # training child: a detached run keeps writing the heartbeat, and
+        # a restarted watchdog would then supervise a process it never
+        # spawned (both reporting healthy on the same file).
+        kill(child)
+        raise
+
+
+def run_relaunch(args, cmd: List[str]) -> int:
+    if not cmd:
+        print("watchdog: --relaunch needs the training command after `--`")
+        return 2
+
+    def spawn():
+        print(f"watchdog: launching: {' '.join(cmd)}")
+        return subprocess.Popen(cmd)
+
+    return supervise(
+        spawn, lambda: run_check(args),
+        interval_s=args.interval, grace_s=args.grace,
+        max_relaunches=args.max_relaunches,
+        backoff_s=args.backoff, backoff_cap_s=args.backoff_cap)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--check", action="store_true", required=True,
-                   help="run the health check (the only mode; the relaunch "
-                        "half is a ROADMAP open item)")
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--check", action="store_true",
+                      help="one-shot health check (exit 0/1/2)")
+    mode.add_argument("--relaunch", action="store_true",
+                      help="supervise the command after `--`: restart on "
+                           "wedge/death with capped backoff")
     p.add_argument("--heartbeat", type=str, required=True,
                    help="heartbeat JSON path (harness --heartbeat)")
     p.add_argument("--max_age", type=float, default=60.0,
@@ -82,7 +208,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "counter (default: no wedge check)")
     p.add_argument("--min_step_rate", type=float, default=None,
                    help="min telemetry steps/sec (default: no stall check)")
-    return run_check(p.parse_args(argv))
+    p.add_argument("--interval", type=float, default=30.0,
+                   help="relaunch mode: seconds between health checks")
+    p.add_argument("--grace", type=float, default=120.0,
+                   help="relaunch mode: seconds after a (re)launch before "
+                        "checks resume (first heartbeat + compile time)")
+    p.add_argument("--max_relaunches", type=int, default=5,
+                   help="relaunch mode: consecutive restarts before giving "
+                        "up (a healthy check refills the budget)")
+    p.add_argument("--backoff", type=float, default=5.0,
+                   help="relaunch mode: initial backoff seconds (doubles "
+                        "per consecutive restart)")
+    p.add_argument("--backoff_cap", type=float, default=300.0,
+                   help="relaunch mode: backoff ceiling")
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # split at the FIRST `--`: left side is parsed STRICTLY (a misspelled
+    # watchdog flag is an argparse error, never silently folded into the
+    # child command), right side is the training command verbatim
+    if "--" in argv:
+        cut = argv.index("--")
+        argv, cmd = argv[:cut], argv[cut + 1:]
+    else:
+        cmd = []
+    args = p.parse_args(argv)
+    if args.check:
+        if cmd:
+            p.error("--check takes no training command (drop the `-- ...`)")
+        return run_check(args)
+    return run_relaunch(args, cmd)
 
 
 if __name__ == "__main__":

@@ -88,6 +88,7 @@ def run_point(
     shard_return_factor: float = 1.25,
     rank: int = 4,
     error_feedback: bool = False,
+    sync_overlap: int = 1,
     batch_size: int = 512,
     image_size: int = 128,
     num_classes: int = 1000,
@@ -120,7 +121,7 @@ def run_point(
         wire_cap_ratio=wire_cap_ratio,
         shard_route_factor=shard_route_factor,
         shard_return_factor=shard_return_factor, rank=rank,
-        error_feedback=error_feedback,
+        error_feedback=error_feedback, sync_overlap=sync_overlap,
     )
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, cfg, ndev),
@@ -169,6 +170,7 @@ def run_point(
         **({"rank": rank} if method is not None and
            canonical_name(method) == "powersgd" else {}),
         "error_feedback": bool(error_feedback),
+        **({"sync_overlap": sync_overlap} if sync_overlap != 1 else {}),
         "devices": ndev,
         "batch": bs,
         "image_size": sz,
@@ -282,6 +284,7 @@ def run_sweep(args) -> List[Dict[str, float]]:
         block_size=args.block_size,
         bucket_mb=args.bucket_mb,
         error_feedback=args.error_feedback,
+        sync_overlap=args.overlap,
     )
     print(f"# dense baseline: {args.model}", file=sys.stderr)
     emit(run_point(method=None, **{**common, "error_feedback": False}))
@@ -366,6 +369,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--block_size", type=int, default=256)
     p.add_argument("--bucket_mb", type=float, default=25.0)
     p.add_argument("--error_feedback", action="store_true")
+    p.add_argument("--overlap", type=int, default=1,
+                   help="sync_overlap chunk count for every grid point "
+                        "(parallel/overlap.py; 1 = single-dispatch sync)")
     p.add_argument("--batch_size", type=int, default=512)
     p.add_argument("--image_size", type=int, default=128,
                    help="input size for the ImageNet archs (CIFAR models fix 32)")

@@ -163,6 +163,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
                         "via comm/shard_overflow)")
     p.add_argument("--error_feedback", action="store_true")
+    p.add_argument("--overlap", type=int, default=1,
+                   help="chunk-pipelined sync (parallel/overlap.py): split "
+                        "the gradient sync into up to K reverse-topological "
+                        "chunk collectives XLA interleaves with backward + "
+                        "per-chunk optimizer compute; numerics unchanged "
+                        "(1 = single dispatch)")
     p.add_argument("--ratio_warmup_epochs", type=int, default=0,
                    help="DGC-style sparsity warm-up (Lin et al., ICLR'18): "
                         "keep-ratio decays geometrically from ~dense to "
@@ -355,6 +361,7 @@ def run(args) -> dict:
             transport=args.transport,
             rank=args.rank,
             error_feedback=args.error_feedback,
+            sync_overlap=args.overlap,
         )
 
     comp = comp_for_ratio(args.ratio)

@@ -256,6 +256,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
                         "via comm/shard_overflow)")
     p.add_argument("--error_feedback", action="store_true")
+    p.add_argument("--overlap", type=int, default=1,
+                   help="chunk-pipelined sync (parallel/overlap.py): up to "
+                        "K reverse-topological chunk collectives interleaved "
+                        "with backward + per-chunk optimizer compute; "
+                        "numerics unchanged (1 = single dispatch)")
     p.add_argument("--wire_cap_ratio", type=float, default=0.05,
                    help="wire thresholdv/adaptive_threshold transport "
                         "capacity (fraction of elements)")
@@ -362,6 +367,7 @@ def run(args) -> Dict[str, float]:
         transport=args.transport,
         rank=args.rank,
         error_feedback=args.error_feedback,
+        sync_overlap=args.overlap,
     )
     guard_cfg, chaos, crash = build_robustness(args, dtype)
     state = TrainState.create(

@@ -108,6 +108,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
                         "via comm/shard_overflow)")
     p.add_argument("--error_feedback", action="store_true")
+    p.add_argument("--overlap", type=int, default=1,
+                   help="chunk-pipelined sync (parallel/overlap.py): up to "
+                        "K reverse-topological chunk collectives per "
+                        "replication signature, interleaved with backward "
+                        "compute; numerics unchanged (1 = single dispatch)")
     # robustness: shared --guard*/--chaos/--heartbeat surface
     from tpu_compressed_dp.harness.loop import (add_robustness_args,
                                                 add_telemetry_args)
@@ -201,6 +206,7 @@ def run(args) -> Dict[str, float]:
         transport=args.transport,
         rank=args.rank,
         error_feedback=args.error_feedback,
+        sync_overlap=args.overlap,
     )
     from tpu_compressed_dp.harness.loop import build_robustness
     from tpu_compressed_dp.train.guard import init_guard_state

@@ -29,7 +29,8 @@ from typing import Callable, Dict, List, Optional
 
 import jax
 
-__all__ = ["PHASES", "phase", "host_span", "StepTimeline", "percentile"]
+__all__ = ["PHASES", "phase", "chunk", "host_span", "StepTimeline",
+           "percentile"]
 
 #: The phase taxonomy — every named scope the engines and step factories
 #: emit uses one of these (xprof filters on the ``tcdp.`` prefix):
@@ -48,6 +49,19 @@ def phase(name: str):
     traced code names the enclosed ops ``tcdp.<name>/...`` in XLA dumps and
     xprof traces.  Usable anywhere (jit, shard_map, host code)."""
     return jax.named_scope(f"tcdp.{name}")
+
+
+def chunk(index: int):
+    """Per-chunk scope for the overlap subsystem
+    (:mod:`tpu_compressed_dp.parallel.overlap`): chunk ``index``'s
+    compress→route→reduce→return pipeline (and, in the fused train-step
+    path, its optimizer-update slice) nests the :data:`PHASES` scopes under
+    ``tcdp.chunk<ii>/``, so xprof — and the AOT schedule evidence
+    (``tools/overlap_evidence.py``) — attribute each collective and each
+    per-chunk ``tcdp.reduce`` / ``tcdp.update`` span to its chunk.  The
+    index is the ISSUE order (0 = first dispatched = the reverse-topological
+    head, i.e. the last parameters' gradients)."""
+    return jax.named_scope(f"tcdp.chunk{index:02d}")
 
 
 def host_span(name: str):

@@ -688,7 +688,8 @@ def _leaf_sync_qsgd(flat: Array, key: Array, qstates: int, axis_name: str, world
     return dense, bits
 
 
-def make_wire_grad_sync(cfg, axis_name: str = "data"):
+def make_wire_grad_sync(cfg, axis_name: str = "data", *,
+                        group_offset: int = 0):
     """Build ``sync(grads, ef, key) -> (synced, new_ef, comm_stats)``.
 
     Same contract as the simulate-mode sync in
@@ -697,6 +698,11 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
     4-tuple — every wire method is stateless, so the compressor state
     passes through untouched); must run inside ``shard_map`` over
     ``axis_name``.
+
+    ``group_offset`` shifts the per-group RNG derivation to the chunk's
+    global group indices when the overlap driver
+    (:mod:`tpu_compressed_dp.parallel.overlap`) syncs a slice of the tree,
+    so chunked and whole-tree syncs draw identical randomness per group.
     """
     from tpu_compressed_dp.parallel.dp import wire_transport
 
@@ -902,7 +908,8 @@ def make_wire_grad_sync(cfg, axis_name: str = "data"):
             flat = group_concat(leaves, idxs)
             with obs_trace.phase("ef"):
                 ef_flat = group_concat(ef_leaves, idxs) if use_ef else None
-            ki = compressors.leaf_key(key, gi, per_worker_rng, axis_name)
+            ki = compressors.leaf_key(key, gi + group_offset, per_worker_rng,
+                                      axis_name)
             # one scope over the whole wire leaf sync (select + pack +
             # combine): the sharded transport's route/reduce/return scopes
             # nest inside (xprof shows tcdp.compress/tcdp.route etc.), and

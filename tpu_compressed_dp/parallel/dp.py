@@ -63,8 +63,8 @@ from tpu_compressed_dp.ops import compressors, kernels
 __all__ = ["CompressionConfig", "make_grad_sync", "make_grouped_grad_sync",
            "make_leaf_groups", "group_concat", "group_split", "init_ef_state",
            "init_comp_state", "init_comp_state_partitioned",
-           "init_comp_state_grouped", "make_sharded_clip", "wire_rides_psum",
-           "wire_transport"]
+           "init_comp_state_grouped", "make_sharded_clip", "merge_stat_dicts",
+           "wire_rides_psum", "wire_transport"]
 
 
 def wire_transport(name: str, n: int, cfg: "CompressionConfig") -> str:
@@ -183,6 +183,24 @@ _DIAG_STATS = {
 }
 
 
+def merge_stat_dicts(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Combine two engine stat dicts from disjoint slices of one sync
+    (signature groups in the partitioned wrapper, chunks in the overlap
+    driver): additive volumes sum; min/max diagnostics (``sync_agree``,
+    ``guard/nonfinite``) combine with their registry-declared reduction,
+    and survive when EITHER side reports them — a slice of diagnostic-free
+    groups must not silence the other slice's divergence signal."""
+    merged = {
+        k: a.get(k, 0.0) + b.get(k, 0.0)
+        for k in (set(a) | set(b)) - set(_DIAG_STATS)
+    }
+    for k, (_, combine) in _DIAG_STATS.items():
+        vals = [c[k] for c in (a, b) if k in c]
+        if vals:
+            merged[k] = vals[0] if len(vals) == 1 else combine(*vals)
+    return merged
+
+
 def _with_guard(inner_sync):
     """Give a ``sync(grads, ef, comp, key)`` engine the optional step-guard
     gate (``ok`` = the globally-voted finiteness verdict,
@@ -290,6 +308,17 @@ class CompressionConfig:
     method: Optional[str] = None
     granularity: str = "layerwise"
     mode: str = "simulate"
+    # sync_overlap: decompose the gradient sync into up to this many
+    # independent chunk syncs issued in reverse-topological order so XLA's
+    # latency-hiding scheduler can interleave each chunk's collective with
+    # the remaining backward (and, in train/step.py, the other chunks'
+    # optimizer-update slices).  1 = the single-dispatch behaviour; K > 1
+    # routes through parallel/overlap.py.  Chunk boundaries always align
+    # with the granularity's reduction-group boundaries, so per-group
+    # compression, RNG and transport are BITWISE unchanged — only the
+    # dependency/schedule structure differs (tests/test_overlap.py pins
+    # this).  Evidence: tools/overlap_evidence.py / benchmarks/.
+    sync_overlap: int = 1
     # transport: which collective carries index-carrying wire payloads.
     # 'allgather' — every worker's (value, index) pairs visit every chip:
     # per-chip volume/decode O(W*k), fine at small W.  'sharded' — the
@@ -339,6 +368,10 @@ class CompressionConfig:
     def __post_init__(self):
         if self.rank < 1:
             raise ValueError(f"rank must be >= 1, got {self.rank}")
+        if self.sync_overlap < 1:
+            raise ValueError(
+                f"sync_overlap must be >= 1, got {self.sync_overlap} "
+                "(1 = single-dispatch sync; K > 1 = chunk-pipelined)")
         if self.granularity not in ("layerwise", "entiremodel", "bucketed"):
             raise ValueError(
                 f"granularity must be layerwise|entiremodel|bucketed, got {self.granularity!r}")
@@ -521,7 +554,8 @@ def group_split(flat, leaves, idxs, out, dtype=None):
         off += n
 
 
-def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
+def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data", *,
+                   group_offset: int = 0, chunking: bool = True):
     """Build ``sync(grads, ef, comp, key[, ok]) -> (synced, new_ef, new_comp,
     stats)`` (``ok`` is the step guard's finiteness verdict — see
     :func:`_with_guard`; omit it for ungated behaviour).
@@ -530,6 +564,14 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     over ``axis_name``).  ``grads`` are the local worker's gradients at the
     same scale the reference compresses (see train/step.py); the return value
     is the world-averaged gradient, matching `core.py:217-222`.
+
+    ``cfg.sync_overlap > 1`` dispatches to the chunk-pipelined driver
+    (:func:`tpu_compressed_dp.parallel.overlap.make_chunked_grad_sync`),
+    which calls back here once per chunk with ``chunking=False`` and the
+    chunk's global ``group_offset``.  The offset shifts the per-group RNG
+    derivation (:func:`~tpu_compressed_dp.ops.compressors.leaf_key`) and the
+    PowerSGD warm-start keys (``q<gi>``) so a chunk's groups compute
+    bitwise-identically to the same groups in a single whole-tree sync.
 
     ``comp`` is the persistent compressor-state pytree
     (:func:`init_comp_state`): the PowerSGD warm-start factors, threaded in
@@ -544,6 +586,13 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     (quantizers send every element at 2-9 bits), ``dense_elems`` the
     uncompressed size.
     """
+    if chunking and cfg.sync_overlap > 1:
+        if group_offset:
+            raise ValueError("group_offset is only meaningful for the "
+                             "per-chunk engines (chunking=False)")
+        from tpu_compressed_dp.parallel import overlap
+
+        return overlap.make_chunked_grad_sync(cfg, axis_name)
     comp = compressors.get_compressor(
         cfg.method, ratio=cfg.ratio, threshold=cfg.threshold,
         qstates=cfg.qstates, block_size=cfg.block_size,
@@ -552,13 +601,15 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     if comp.name == "powersgd":
         # stateful warm-started path; the factors ARE the wire form, so
         # simulate and wire modes share it
-        return _with_guard(_make_powersgd_sync(cfg, axis_name))
+        return _with_guard(
+            _make_powersgd_sync(cfg, axis_name, group_offset=group_offset))
     if cfg.mode == "wire" and comp.name != "none":
         # Dense (method=None) has no sparse representation — the simulate
         # path's full-size psum IS its wire format, so fall through.
         from tpu_compressed_dp.ops import wire
 
-        wire_sync = wire.make_wire_grad_sync(cfg, axis_name)
+        wire_sync = wire.make_wire_grad_sync(cfg, axis_name,
+                                             group_offset=group_offset)
 
         def sync_wire(grads: Any, ef: Any, comp_state: Any, key: jax.Array):
             out, new_ef, stats = wire_sync(grads, ef, key)
@@ -608,7 +659,11 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
         return sent * bits_per_elem
 
     def compress_flat(flat: jax.Array, key: jax.Array, index: int) -> jax.Array:
-        k = compressors.leaf_key(key, index, per_worker_rng and comp.needs_rng, axis_name)
+        # index is the GLOBAL group index (group_offset shifts a chunk's
+        # local indices), so chunked and whole-tree syncs draw identical
+        # per-group randomness
+        k = compressors.leaf_key(key, index + group_offset,
+                                 per_worker_rng and comp.needs_rng, axis_name)
         return comp.fn(flat, k)
 
     def sync(grads: Any, ef: Any, comp_state: Any, key: jax.Array
@@ -700,7 +755,8 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data"):
     return _with_guard(sync)
 
 
-def _make_powersgd_sync(cfg: CompressionConfig, axis_name):
+def _make_powersgd_sync(cfg: CompressionConfig, axis_name, *,
+                        group_offset: int = 0):
     """The stateful PowerSGD engine behind :func:`make_grad_sync`.
 
     Per group: one warm-started power-iteration step against the persistent
@@ -765,7 +821,7 @@ def _make_powersgd_sync(cfg: CompressionConfig, axis_name):
                 group_sent, group_bits = float(n_g), 32.0 * n_g
                 n_coll += 1
             else:
-                qk = f"q{gi}"
+                qk = f"q{gi + group_offset}"  # global key: chunk-invariant
                 if not isinstance(comp_state, dict) or qk not in comp_state:
                     raise ValueError(
                         f"powersgd sync needs warm-start state {qk!r}; build "
@@ -884,23 +940,7 @@ def make_partitioned_grad_sync(cfg: CompressionConfig, sync_axes,
                 s_comm = {k: (_DIAG_STATS[k][0](v, sig) if k in _DIAG_STATS
                               else jax.lax.psum(v, sig))
                           for k, v in s_comm.items()}
-            if comm is None:
-                comm = s_comm
-            else:
-                merged = {
-                    k: comm.get(k, 0.0) + s_comm.get(k, 0.0)
-                    for k in (set(comm) | set(s_comm)) - set(_DIAG_STATS)
-                }
-                # keep a diagnostic when EITHER side reports it: a signature
-                # of dense-fallback-only groups emits no sync_agree, and
-                # dropping the other side's value would silence exactly the
-                # divergence signal check_sync exists to surface
-                for k, (_, combine) in _DIAG_STATS.items():
-                    vals = [c[k] for c in (comm, s_comm) if k in c]
-                    if vals:
-                        merged[k] = (vals[0] if len(vals) == 1
-                                     else combine(*vals))
-                comm = merged
+            comm = s_comm if comm is None else merge_stat_dicts(comm, s_comm)
         synced = merge(grads, out_g)
         new_ef = merge(ef, out_e) if use_ef else ()
         return synced, new_ef, new_comp if new_comp else (), comm

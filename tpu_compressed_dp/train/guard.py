@@ -52,7 +52,7 @@ __all__ = [
     "GuardConfig", "GuardState", "GuardExceeded", "init_guard_state",
     "tree_all_finite", "finite_vote", "select_tree", "update_guard",
     "guard_metrics", "check_guard_metrics", "worker_index",
-    "guard_to_dict", "guard_from_dict",
+    "guard_to_dict", "guard_from_dict", "schedule_step",
 ]
 
 
@@ -84,6 +84,13 @@ class GuardConfig:
     growth_interval: int = 200
     max_consecutive_skips: int = 25
     loss_scaling: bool = True
+    # Guard-aware LR rewind (ROADMAP): schedule-valued hyper-parameters key
+    # off the APPLIED-update count (step - total_skipped) instead of the raw
+    # attempt counter, so N vetoed steps leave the LR exactly where an
+    # unskipped run of the same good-step count would — a burst of skips no
+    # longer fast-forwards warmup/anneal.  Constant hyper-parameters are
+    # unaffected; the raw step still drives RNG streams and checkpointing.
+    lr_rewind: bool = True
 
     def __post_init__(self):
         if not (0.0 < self.backoff < 1.0):
@@ -195,6 +202,25 @@ def worker_index(axis_names: Union[str, Sequence[str]]) -> Array:
     for ax in axis_names:
         idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
     return idx
+
+
+def schedule_step(cfg: Optional[GuardConfig], gs: Any, new_step: Array) -> Array:
+    """The step value schedule-valued hyper-parameters (LR, momentum, wd)
+    should be evaluated at — the guard-aware LR rewind
+    (``GuardConfig.lr_rewind``).
+
+    ``new_step - total_skipped`` counts APPLIED updates: a vetoed step
+    advances the raw attempt counter (RNG stream, checkpoint naming) but
+    not the schedule clock, so after N skips the LR sits exactly where an
+    unskipped run of the same good-step count would put it.  On a vetoed
+    step the computed update is discarded anyway, so the (one-behind) value
+    it sees is irrelevant.  ``gs`` is the PRE-step :class:`GuardState`
+    (``state.guard``); pass-through when the guard is off or rewind is
+    disabled.
+    """
+    if cfg is None or not cfg.lr_rewind or gs == ():
+        return new_step
+    return new_step - gs.total_skipped
 
 
 def select_tree(ok: Array, new: Any, old: Any) -> Any:

@@ -178,6 +178,15 @@ def make_lm_train_step(
     shard's gradient slice must veto the update on every replica, or the
     tensor-sharded params would de-synchronise.  Chaos targets one
     (data, seq) compression worker across all its tensor shards.
+
+    ``comp_cfg.sync_overlap > 1`` chunk-pipelines each replication
+    signature's sync (the grouped wrapper's base engines dispatch through
+    :mod:`tpu_compressed_dp.parallel.overlap`): K reverse-topological chunk
+    collectives per signature, interleavable with the remaining backward.
+    The per-chunk optimizer interleave stays a pure-DP
+    (:func:`~tpu_compressed_dp.train.step.make_train_step`) optimisation —
+    signature groups interleave leaves across chunk boundaries here, so the
+    update runs whole-tree after the chunked sync.
     """
     cfg.validate_mesh(mesh.shape["tensor"])
     from tpu_compressed_dp.ops.compressors import canonical_name
@@ -267,9 +276,11 @@ def make_lm_train_step(
             synced = clip_tree(synced, clip_sent_norm)
 
         new_step = state.step + 1
+        # guard-aware LR rewind: schedules key off the applied-update count
+        sched_step = guard_mod.schedule_step(guard_cfg, state.guard, new_step)
         with obs_trace.phase("update"):
             new_params, new_opt = optimizer.apply(state.params, synced,
-                                                  state.opt_state, new_step)
+                                                  state.opt_state, sched_step)
         new_guard = state.guard
         if guarded:
             new_params = guard_mod.select_tree(ok, new_params, state.params)
@@ -281,7 +292,7 @@ def make_lm_train_step(
         metrics = {
             "loss": jax.lax.pmean(loss, sync_axes),
             "tokens": jax.lax.psum(ntok, sync_axes),
-            "lr": optimizer_lr(optimizer, new_step),
+            "lr": optimizer_lr(optimizer, sched_step),
         }
         if guarded:
             metrics.update(guard_mod.guard_metrics(new_guard))

@@ -236,6 +236,12 @@ def make_pp_train_step(
     stage's layer-stack gradient must veto the update on all stages, or the
     pipeline's replicated embed/head params would de-synchronise from the
     stage-local layers.
+
+    ``comp_cfg.sync_overlap > 1`` chunk-pipelines each replication
+    signature's data-axis sync (the partitioned wrapper's base engines
+    dispatch through :mod:`tpu_compressed_dp.parallel.overlap`); the
+    optimizer update stays whole-tree, as in
+    :func:`~tpu_compressed_dp.train.lm_step.make_lm_train_step`.
     """
     from tpu_compressed_dp.ops.compressors import canonical_name
 
@@ -408,9 +414,11 @@ def make_pp_train_step(
             synced = clip_tree(synced, clip_sent_norm)
 
         new_step = state.step + 1
+        # guard-aware LR rewind: schedules key off the applied-update count
+        sched_step = guard_mod.schedule_step(guard_cfg, state.guard, new_step)
         with obs_trace.phase("update"):
             new_params, new_opt = optimizer.apply(state.params, synced,
-                                                  state.opt_state, new_step)
+                                                  state.opt_state, sched_step)
         new_guard = state.guard
         if guarded:
             new_params = guard_mod.select_tree(ok, new_params, state.params)
@@ -422,7 +430,7 @@ def make_pp_train_step(
             "loss": jax.lax.pmean(loss, sync_axes),
             "tokens": jax.lax.psum(
                 jnp.asarray(b_local * t_len, jnp.float32), sync_axes),
-            "lr": optimizer_lr(optimizer, new_step),
+            "lr": optimizer_lr(optimizer, sched_step),
         }
         if guarded:
             metrics.update(guard_mod.guard_metrics(new_guard))

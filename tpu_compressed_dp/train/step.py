@@ -114,8 +114,28 @@ def make_train_step(
     step (:mod:`tpu_compressed_dp.utils.chaos`): NaN/Inf into one worker's
     gradients or loss at step-counter-chosen steps — the adversary the
     guard is tested against (tools/chaos_drill.py).
+
+    ``comp_cfg.sync_overlap > 1`` chunk-pipelines the gradient sync
+    (:mod:`tpu_compressed_dp.parallel.overlap`): the sync decomposes into K
+    reverse-topological chunk collectives the scheduler interleaves with
+    the remaining backward pass, and — when ``clip_sent_norm`` is off —
+    each chunk's slice of the optimizer update is traced right after its
+    reduce so it can run while the next chunk's collective is in flight.
+    Bitwise-identical numerics either way; ``clip_sent_norm > 0`` needs the
+    global synced-gradient norm (a barrier over all chunks), so that path
+    keeps the whole-tree update after the chunked sync.
     """
     grad_sync = make_grad_sync(comp_cfg, axis_name)
+    fused_overlap = None
+    if (comp_cfg.sync_overlap > 1 and clip_sent_norm == 0.0
+            and isinstance(optimizer, SGD)):
+        # the per-chunk interleave slices the optimizer leaf-for-leaf and
+        # reaches into opt_state["momentum"]/wd_mask — SGD's shape; any
+        # other optimizer keeps chunked sync + whole-tree apply
+        from tpu_compressed_dp.parallel import overlap as overlap_mod
+
+        fused_overlap = overlap_mod.make_overlap_sync_apply(
+            comp_cfg, optimizer, axis_name)
     guarded = guard_cfg is not None
     inject = chaos is not None and chaos.injects_in_graph
     if inject and chaos.worker >= mesh.shape[axis_name]:
@@ -175,20 +195,33 @@ def make_train_step(
         # mesh; squeeze the local slice here.
         ef_local = jax.tree.map(lambda e: e[0], state.ef)
         comp_local = jax.tree.map(lambda c: c[0], state.comp)
-        synced, new_ef, new_comp, comm = grad_sync(
-            scaled, ef_local, comp_local, comp_key, ok=ok)
+        new_step = state.step + 1
+        # guard-aware LR rewind: schedules see the applied-update count, so
+        # vetoed steps don't fast-forward the schedule clock
+        sched_step = guard_mod.schedule_step(guard_cfg, state.guard, new_step)
+        if fused_overlap is not None:
+            # chunk-pipelined sync + per-chunk optimizer interleave: chunk
+            # i's update slice runs while chunk i+1's collective is in
+            # flight (the vote `ok` was computed once, above, before any
+            # chunk dispatches)
+            new_params, new_opt, new_ef, new_comp, comm = fused_overlap(
+                state.params, scaled, ef_local, comp_local, state.opt_state,
+                comp_key, sched_step, ok=ok)
+        else:
+            synced, new_ef, new_comp, comm = grad_sync(
+                scaled, ef_local, comp_local, comp_key, ok=ok)
+            if clip_sent_norm > 0.0:
+                snorm = jnp.sqrt(
+                    sum(jnp.sum(g * g) for g in jax.tree.leaves(synced)))
+                sfactor = jnp.minimum(
+                    1.0,
+                    clip_sent_norm * grad_scale / jnp.maximum(snorm, 1e-20))
+                synced = jax.tree.map(lambda g: g * sfactor, synced)
+            with obs_trace.phase("update"):
+                new_params, new_opt = optimizer.apply(
+                    state.params, synced, state.opt_state, sched_step)
         new_ef = jax.tree.map(lambda e: e[None], new_ef)
         new_comp = jax.tree.map(lambda c: c[None], new_comp)
-        if clip_sent_norm > 0.0:
-            snorm = jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(synced)))
-            sfactor = jnp.minimum(
-                1.0, clip_sent_norm * grad_scale / jnp.maximum(snorm, 1e-20))
-            synced = jax.tree.map(lambda g: g * sfactor, synced)
-
-        new_step = state.step + 1
-        with obs_trace.phase("update"):
-            new_params, new_opt = optimizer.apply(
-                state.params, synced, state.opt_state, new_step)
 
         # BN running stats are computed from the local shard; average them so
         # the replicated state stays consistent.  Normalisation itself still
@@ -217,7 +250,7 @@ def make_train_step(
             "loss": jax.lax.psum(loss * local_bs, axis_name) / jax.lax.psum(local_bs, axis_name),
             "correct": jax.lax.psum(correct, axis_name),
             "count": jax.lax.psum(local_bs, axis_name),
-            "lr": optimizer_lr(optimizer, new_step),
+            "lr": optimizer_lr(optimizer, sched_step),
         }
         if guarded:
             metrics.update(guard_mod.guard_metrics(new_guard))
