@@ -18,12 +18,17 @@ from tools import chaos_drill  # noqa: E402
 @pytest.mark.quick
 def test_quick_drill(mesh8):
     """tier-1 smoke: skip consistency, loss-scale dynamics, the wedge
-    raise, and bitwise crash recovery through run_with_recovery."""
+    raise, bitwise crash recovery through run_with_recovery, and the
+    elastic invariants (gossip detection + one mid-collective kill ->
+    W-1 remesh with bitwise EF fold)."""
     results = chaos_drill.run_drills(chaos_drill.QUICK, mesh=mesh8)
     assert results["skip_consistency"]["nonfinite"] == [0.0, 0.0, 1.0, 0.0, 0.0]
     assert results["loss_scale"]["scales"][:2] == [1024.0, 512.0]
     assert results["max_skips"]["raised_at_step"] == 3
     assert results["crash_recovery"]["restores"] == 1
+    assert results["elastic_gossip"]["detected"] == [2]
+    assert results["elastic_remesh"]["world"] == 7
+    assert results["elastic_remesh"]["dropped_ef_norm"] == 0.0  # fold policy
 
 
 @pytest.mark.slow
@@ -33,6 +38,19 @@ def test_full_drill_matrix(mesh8):
         mesh=mesh8)
     assert results["ef_identity"]["max_gap"] < 1e-5
     assert results["ef_identity_sharded"]["max_gap"] < 1e-5
+    # elastic matrix: every kill-step x worker x EF-policy cell remeshed to
+    # W-1; drop cells with a warm EF account a positive abandoned norm
+    assert results["elastic_readmit"] == {"world": 8, "readmits": 1}
+    for policy in ("fold", "drop"):
+        for worker in (0, 7):
+            for kill_step in (0, 3):
+                cell = results[f"elastic[{policy},w{worker},s{kill_step}]"]
+                assert cell["world"] == 7
+                if policy == "fold":
+                    assert cell["dropped_ef_norm"] == 0.0
+                elif kill_step > 0:
+                    assert cell["dropped_ef_norm"] > 0.0
+    assert results["elastic[sharded-wire]"]["world"] == 7
 
 
 @pytest.mark.slow

@@ -1,0 +1,402 @@
+"""Elastic training unit tests (train/elastic.py + the chaos surface).
+
+The end-to-end invariants (mid-collective kill -> W-1 remesh -> bitwise EF
+migration -> run completes) live in tools/chaos_drill.py and
+tests/test_chaos_drill.py; this module covers the pieces host-side:
+failure detection (gossip incarnations, bounded fetch), the chaos spec
+round-trips, the state-migration arithmetic, and the runtime's conversion
+and refusal rules.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.train import elastic
+from tpu_compressed_dp.utils.chaos import (ChaosConfig, ChaosCrash,
+                                           CrashInjector)
+
+pytestmark = pytest.mark.quick
+
+
+# ----------------------------------------------------------- chaos surface
+
+class TestChaosSpec:
+    def test_parse_mid_collective(self):
+        c = ChaosConfig.parse("crash=mid_collective,crash_at_step=12,worker=3")
+        assert c.crash_mode == "mid_collective"
+        assert c.crash_at_step == 12 and c.worker == 3
+        assert not c.injects_in_graph  # crash-only chaos stays host-side
+
+    def test_parse_peer_timeout(self):
+        c = ChaosConfig.parse("crash=mid_collective,crash_at_step=1,"
+                              "peer_timeout=0.5")
+        assert c.peer_timeout == 0.5
+
+    def test_to_spec_round_trips(self):
+        for spec in (
+            "crash=mid_collective,crash_at_step=12,worker=3,peer_timeout=0.5",
+            "crash=7",
+            "nan,target=grads,steps=3,worker=1",
+            "inf,target=loss,every=2,crash_at_step=9",
+        ):
+            c = ChaosConfig.parse(spec)
+            assert ChaosConfig.parse(c.to_spec()) == c, spec
+
+    def test_bad_crash_mode_rejected(self):
+        with pytest.raises(ValueError, match="crash_mode"):
+            ChaosConfig(crash_at_step=1, crash_mode="sideways")
+        with pytest.raises(ValueError):
+            ChaosConfig.parse("crash=mid_collective,peer_timeout=-1")
+
+    def test_injector_fires_only_in_its_phase(self):
+        inj = CrashInjector(3, mode="mid_collective", worker=5)
+        for i in range(5):
+            inj.check(i)  # the pre-dispatch phase never fires this mode
+        inj2 = CrashInjector(3, mode="mid_collective", worker=5)
+        inj2.check(3)
+        with pytest.raises(ChaosCrash) as ei:
+            inj2.check(3, phase="mid_collective")
+        assert ei.value.step == 3 and ei.value.worker == 5
+        assert ei.value.mode == "mid_collective"
+        # step-mode injectors keep the legacy behavior (fire pre-dispatch)
+        inj3 = CrashInjector(2)
+        inj3.check(1)
+        with pytest.raises(ChaosCrash):
+            inj3.check(2)
+
+
+# ----------------------------------------------------------------- config
+
+class TestElasticConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="ef_policy"):
+            elastic.ElasticConfig(ef_policy="average")
+        with pytest.raises(ValueError, match="peer_timeout_s"):
+            elastic.ElasticConfig(peer_timeout_s=0.0)
+        with pytest.raises(ValueError, match="min_world"):
+            elastic.ElasticConfig(min_world=0)
+        c = elastic.ElasticConfig(ef_policy="drop", peer_timeout_s=1.0)
+        assert c.ef_policy == "drop"
+
+
+# ----------------------------------------------------------------- gossip
+
+class TestPeerGossip:
+    def _gossip(self, td, clock, world=3, timeout=5.0, rank=0):
+        return elastic.PeerGossip(str(td), rank, world,
+                                  peer_timeout_s=timeout,
+                                  now=lambda: clock["t"])
+
+    def test_silent_peer_declared_dead_after_timeout(self, tmp_path):
+        clock = {"t": 100.0}
+        g = self._gossip(tmp_path, clock)
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 0, ts=clock["t"])
+        # peer 2 never writes at all; cold-start grace covers both at first
+        assert g.check() == {}
+        clock["t"] += 6.0
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 1, ts=clock["t"])
+        newly = g.check()
+        assert list(newly) == [2]
+        assert g.dead == (2,)
+        # already-dead peers are not re-reported as newly dead
+        clock["t"] += 6.0
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 2, ts=clock["t"])
+        assert g.check() == {}
+
+    def test_beat_writes_own_file_rate_limited(self, tmp_path, monkeypatch):
+        from tpu_compressed_dp.utils.resilience import read_heartbeat
+
+        monkeypatch.setenv("TCDP_RESTART_COUNT", "2")
+        clock = {"t": 100.0}
+        g = self._gossip(tmp_path, clock, world=2)       # timeout 5s
+        g.beat(step=3)
+        own = elastic.heartbeat_path(str(tmp_path), 0)
+        rec = read_heartbeat(own)
+        assert rec["step"] == 3 and rec["incarnation"] == 2
+        clock["t"] += 1.0                                # < timeout/4
+        g.beat(step=4)
+        assert read_heartbeat(own)["step"] == 3          # rate-limited
+        clock["t"] += 0.5                                # crosses 1.25s
+        g.beat(step=5)
+        assert read_heartbeat(own)["step"] == 5
+        # the written file closes the loop: a peer's gossip sees us alive
+        g2 = self._gossip(tmp_path, clock, world=2, rank=1)
+        clock["t"] += 4.0
+        g.beat(step=6)
+        clock["t"] += 2.0                                # beat is 2s old: fresh
+        assert g2.check() == {}
+
+    def test_raise_if_dead_carries_step_and_ranks(self, tmp_path):
+        clock = {"t": 100.0}
+        g = self._gossip(tmp_path, clock)
+        clock["t"] += 6.0
+        with pytest.raises(elastic.PeerFailed) as ei:
+            g.raise_if_dead(step=17)
+        assert ei.value.failed == (1, 2) and ei.value.step == 17
+
+    def test_stale_lower_incarnation_never_refreshes(self, tmp_path):
+        """A dead prior life's file (lower incarnation) reappearing with a
+        fresh ts must NOT read as liveness of the tracked peer."""
+        clock = {"t": 100.0}
+        g = self._gossip(tmp_path, clock, world=2)
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 0, incarnation=2,
+                                     ts=clock["t"])
+        assert g.check() == {}          # admits incarnation 2
+        clock["t"] += 4.0
+        # an NFS-delayed write of incarnation 1 lands with a FRESH ts
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 9, incarnation=1,
+                                     ts=clock["t"])
+        clock["t"] += 3.0               # 7s since the last REAL beat
+        newly = g.check()
+        assert list(newly) == [1], "stale incarnation refreshed liveness"
+
+    def test_incarnation_advance_means_peer_restarted(self, tmp_path):
+        clock = {"t": 100.0}
+        g = self._gossip(tmp_path, clock, world=2)
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 5, incarnation=0,
+                                     ts=clock["t"])
+        assert g.check() == {}
+        clock["t"] += 1.0               # well within the timeout…
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 0, incarnation=1,
+                                     ts=clock["t"])
+        newly = g.check()               # …but the tracked life is gone
+        assert list(newly) == [1] and "incarnation" in newly[1]
+        assert g.rejoin_candidates() == {1: 1}
+        g.readmit(1)
+        assert g.dead == () and g.check() == {}
+
+    def test_dead_peer_rejoins_on_fresh_higher_incarnation(self, tmp_path):
+        clock = {"t": 100.0}
+        g = self._gossip(tmp_path, clock, world=2)
+        clock["t"] += 6.0               # silence -> dead
+        assert list(g.check()) == [1]
+        assert g.rejoin_candidates() == {}
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 0, incarnation=1,
+                                     ts=clock["t"])
+        assert g.rejoin_candidates() == {1: 1}
+        # …but a fresh file of the SAME (dead) incarnation is not a rejoin:
+        # the paused process's in-memory state is stale relative to the
+        # remeshed run; it must restart (bump incarnation) to come back
+        elastic.write_peer_heartbeat(str(tmp_path), 0, 0, incarnation=0,
+                                     ts=clock["t"])
+        g2 = self._gossip(tmp_path, clock, world=2, rank=1)
+        assert g2.check() == {}         # admits rank 0 at incarnation 0
+        g2.note_dead([0])
+        clock["t"] += 1.0
+        elastic.write_peer_heartbeat(str(tmp_path), 0, 1, incarnation=0,
+                                     ts=clock["t"])
+        assert g2.rejoin_candidates() == {}
+
+
+# ------------------------------------------------------------ bounded fetch
+
+class TestFetchWithTimeout:
+    def test_value_passes_through(self):
+        assert elastic.fetch_with_timeout(lambda: 42, 5.0) == 42
+
+    def test_timeout_raises_peer_failed(self):
+        ev = threading.Event()
+        with pytest.raises(elastic.PeerFailed) as ei:
+            elastic.fetch_with_timeout(lambda: ev.wait(30.0), 0.05, step=7,
+                                       what="drill fetch")
+        ev.set()
+        assert ei.value.failed == () and ei.value.step == 7
+        assert "drill fetch" in str(ei.value)
+
+    def test_thunk_exception_re_raised(self):
+        def boom():
+            raise KeyError("inner")
+
+        with pytest.raises(KeyError, match="inner"):
+            elastic.fetch_with_timeout(boom, 5.0)
+
+
+# ---------------------------------------------------------- state migration
+
+def _tree(w=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"a": rng.randn(w, 8).astype(np.float32),
+            "b": rng.randn(w, 3, 2).astype(np.float32)}
+
+
+class TestMigration:
+    def test_fold_conserves_mass_bitwise(self):
+        ef = _tree()
+        out, dropped = elastic.migrate_ef(ef, [2], policy="fold")
+        assert dropped == 0.0
+        for k in ef:
+            expect = np.delete(ef[k], [2], axis=0)
+            expect[0] = expect[0] + ef[k][2]
+            assert np.array_equal(out[k], expect)
+            # exact fp32 conservation: the summed mass is unchanged up to
+            # the one add per leaf the fold performs
+            assert out[k].shape[0] == 3
+
+    def test_fold_into_other_survivor(self):
+        ef = _tree()
+        out, _ = elastic.migrate_ef(ef, [0], policy="fold", fold_into=1)
+        expect = np.delete(ef["a"], [0], axis=0)
+        expect[1] = expect[1] + ef["a"][0]
+        assert np.array_equal(out["a"], expect)
+
+    def test_drop_accounts_l2_norm(self):
+        ef = _tree()
+        out, dropped = elastic.migrate_ef(ef, [1, 3], policy="drop")
+        sq = sum(float(np.sum(ef[k][[1, 3]].astype(np.float64) ** 2))
+                 for k in ef)
+        assert dropped == pytest.approx(np.sqrt(sq), rel=0, abs=0)
+        for k in ef:
+            assert np.array_equal(out[k], np.delete(ef[k], [1, 3], axis=0))
+
+    def test_multi_failure_fold_sums_all_lost_rows(self):
+        ef = _tree()
+        out, _ = elastic.migrate_ef(ef, [1, 2], policy="fold")
+        expect = np.delete(ef["a"], [1, 2], axis=0)
+        expect[0] = expect[0] + (ef["a"][1] + ef["a"][2])
+        assert np.array_equal(out["a"], expect)
+
+    def test_empty_ef_passes_through(self):
+        assert elastic.migrate_ef((), [1]) == ((), 0.0)
+        assert elastic.migrate_comp((), [1]) == ()
+
+    def test_bad_worker_index_raises(self):
+        with pytest.raises(ValueError):
+            elastic.migrate_ef(_tree(w=2), [5])
+        with pytest.raises(ValueError):
+            elastic.migrate_ef(_tree(), [1], policy="average")
+
+    def test_comp_rows_deleted(self):
+        comp = _tree(seed=1)
+        out = elastic.migrate_comp(comp, [0])
+        for k in comp:
+            assert np.array_equal(out[k], comp[k][1:])
+
+    def test_expand_ef_appends_zero_rows(self):
+        ef = _tree(w=3)
+        out = elastic.expand_ef(ef, 2)
+        for k in ef:
+            assert out[k].shape[0] == 5
+            assert np.array_equal(out[k][:3], ef[k])
+            assert not np.any(out[k][3:])
+
+    def test_expand_comp_broadcasts_row0(self):
+        comp = _tree(w=3, seed=2)
+        out = elastic.expand_comp(comp, 2)
+        for k in comp:
+            assert out[k].shape[0] == 5
+            assert np.array_equal(out[k][3], comp[k][0])
+            assert np.array_equal(out[k][4], comp[k][0])
+
+
+class TestTrimBatches:
+    def test_trims_rows_and_keeps_len(self):
+        inner = [{"x": np.arange(8), "y": np.arange(8) * 2} for _ in range(3)]
+        view = elastic.TrimBatches(inner, 6)
+        assert len(view) == 3
+        for b in view:
+            assert b["x"].shape[0] == 6 and b["y"].shape[0] == 6
+        # short batches pass through untouched
+        short = elastic.TrimBatches([{"x": np.arange(4)}], 6)
+        assert next(iter(short))["x"].shape[0] == 4
+
+
+# ------------------------------------------------------------- mesh surgery
+
+class TestMeshSurgery:
+    def test_surviving_mesh_drops_workers_in_order(self, mesh8):
+        new_mesh, removed = elastic.surviving_mesh(mesh8, [2, 5])
+        devices = list(mesh8.devices.reshape(-1))
+        assert list(new_mesh.devices.reshape(-1)) == [
+            d for i, d in enumerate(devices) if i not in (2, 5)]
+        assert removed == [devices[2], devices[5]]
+        assert tuple(new_mesh.axis_names) == ("data",)
+        assert new_mesh.shape["data"] == 6
+
+    def test_extended_mesh_appends_at_tail(self, mesh8):
+        new_mesh, removed = elastic.surviving_mesh(mesh8, [0])
+        back = elastic.extended_mesh(new_mesh, removed)
+        devices = list(mesh8.devices.reshape(-1))
+        assert list(back.devices.reshape(-1)) == devices[1:] + [devices[0]]
+
+    def test_rejects_model_parallel_mesh(self):
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, ("data", "tensor"))
+        with pytest.raises(ValueError, match="model axes"):
+            elastic.surviving_mesh(mesh, [1])
+
+    def test_unit_model_axes_accepted(self):
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:4]).reshape(4, 1, 1)
+        mesh = Mesh(devs, ("data", "seq", "tensor"))
+        new_mesh, _ = elastic.surviving_mesh(mesh, [3])
+        assert tuple(new_mesh.axis_names) == ("data", "seq", "tensor")
+        assert new_mesh.shape["data"] == 3
+
+    def test_out_of_range_failure_rejected(self, mesh8):
+        with pytest.raises(ValueError, match="outside world"):
+            elastic.surviving_mesh(mesh8, [8])
+
+
+# ----------------------------------------------------------------- runtime
+
+class TestElasticRuntime:
+    def _runtime(self, mesh, **cfg_kw):
+        return elastic.ElasticRuntime(
+            elastic.ElasticConfig(**cfg_kw), mesh, log=lambda s: None)
+
+    def test_failure_from_conversions(self, mesh8):
+        el = self._runtime(mesh8)
+        # PeerFailed passes through untouched
+        pf = elastic.PeerFailed((3,), step=5)
+        assert el.failure_from(pf) is pf
+        # mid-collective chaos converts to the dying worker
+        crash = ChaosCrash("boom")
+        crash.step, crash.mode, crash.worker = 4, "mid_collective", 6
+        out = el.failure_from(crash)
+        assert out.failed == (6,) and out.step == 4
+        # step-mode crashes (watchdog territory) and unrelated faults do not
+        crash2 = ChaosCrash("boom")
+        crash2.step, crash2.mode, crash2.worker = 4, "step", 6
+        assert el.failure_from(crash2) is None
+        assert el.failure_from(RuntimeError("x")) is None
+
+    def test_empty_culprit_filled_from_gossip(self, mesh8, tmp_path):
+        clock = {"t": 100.0}
+        gossip = elastic.PeerGossip(str(tmp_path), 0, 8, peer_timeout_s=5.0,
+                                    now=lambda: clock["t"])
+        el = elastic.ElasticRuntime(elastic.ElasticConfig(), mesh8,
+                                    gossip=gossip, log=lambda s: None)
+        clock["t"] += 6.0               # every peer silent past the timeout
+        out = el.failure_from(elastic.PeerFailed((), step=3,
+                                                 reason="fetch timeout"))
+        assert out.failed == tuple(range(1, 8)) and out.step == 3
+
+    def test_min_world_refusal(self, mesh8):
+        el = self._runtime(mesh8, min_world=8)
+
+        class FakeState:
+            ef = ()
+            comp = ()
+
+        with pytest.raises(elastic.PeerFailed, match="min_world"):
+            el.handle_failure(FakeState(), elastic.PeerFailed((1,), step=0))
+        assert el.remesh_count == 0
+
+    def test_culpritless_failure_re_raised(self, mesh8):
+        el = self._runtime(mesh8)
+        with pytest.raises(elastic.PeerFailed):
+            el.handle_failure(object(), elastic.PeerFailed((), step=0))
+
+    def test_metrics_are_declared(self, mesh8):
+        from tpu_compressed_dp.obs import registry
+
+        el = self._runtime(mesh8)
+        for key in el.metrics():
+            assert registry.is_declared(key), key

@@ -50,6 +50,80 @@ class TestHeartbeat:
         rec = resilience.read_heartbeat(p)
         assert rec is not None and rec["step"] == i
 
+    def test_incarnation_defaults_to_restart_count(self, tmp_path,
+                                                   monkeypatch):
+        """The heartbeat record carries the incarnation the elastic gossip
+        disambiguates stale files with; it seeds from TCDP_RESTART_COUNT,
+        which `tools/watchdog.py --relaunch` exports to each child."""
+        p = str(tmp_path / "hb.json")
+        monkeypatch.setenv("TCDP_RESTART_COUNT", "3")
+        hb = resilience.Heartbeat(p, interval_s=10.0)
+        hb.update(step=1)
+        hb.stop()
+        assert resilience.read_heartbeat(p)["incarnation"] == 3
+        # explicit argument wins over the environment
+        monkeypatch.setenv("TCDP_RESTART_COUNT", "9")
+        hb2 = resilience.Heartbeat(p, interval_s=10.0, incarnation=1)
+        hb2.update(step=2)
+        hb2.stop()
+        assert resilience.read_heartbeat(p)["incarnation"] == 1
+        # absent/garbage env -> incarnation 0 (first life)
+        monkeypatch.delenv("TCDP_RESTART_COUNT")
+        hb3 = resilience.Heartbeat(p, interval_s=10.0)
+        hb3.update(step=3)
+        hb3.stop()
+        assert resilience.read_heartbeat(p)["incarnation"] == 0
+
+
+class TestTornReads:
+    """read_heartbeat must answer None — never raise — on the torn/partial
+    states a reader can catch a gossip directory in; the writer side is
+    atomic (tmp + os.replace), so a COMPLETE read is always valid JSON."""
+
+    def test_truncated_json_reads_none(self, tmp_path):
+        p = tmp_path / "hb.json"
+        p.write_text('{"ts": 123.0, "st')        # torn mid-record
+        assert resilience.read_heartbeat(str(p)) is None
+        assert resilience.is_stale(str(p), max_age_s=1e9)
+
+    def test_binary_garbage_reads_none(self, tmp_path):
+        p = tmp_path / "hb.json"
+        p.write_bytes(b"\xff\xfe\x00garbage\x80")
+        assert resilience.read_heartbeat(str(p)) is None
+
+    def test_empty_and_non_dict_read_none(self, tmp_path):
+        p = tmp_path / "hb.json"
+        p.write_text("")
+        assert resilience.read_heartbeat(str(p)) is None
+        p.write_text("[1, 2, 3]")                # valid JSON, wrong shape
+        assert resilience.read_heartbeat(str(p)) is None
+        p.write_text('"ts"')
+        assert resilience.read_heartbeat(str(p)) is None
+
+    def test_non_numeric_ts_is_stale(self, tmp_path):
+        p = tmp_path / "hb.json"
+        p.write_text('{"ts": "soon", "step": 1}')
+        assert resilience.is_stale(str(p), max_age_s=1e9)
+        p.write_text('{"ts": true, "step": 1}')  # bool is not a timestamp
+        assert resilience.is_stale(str(p), max_age_s=1e9)
+
+    def test_writer_replace_is_atomic_under_hammer(self, tmp_path):
+        """A hot writer + a hot reader: every read observes either None
+        (file not yet created) or a COMPLETE record — no partial JSON ever
+        surfaces through the tmp+replace protocol."""
+        p = str(tmp_path / "hb.json")
+        hb = resilience.Heartbeat(p, interval_s=0.0005)
+        deadline = time.time() + 0.5
+        reads = 0
+        while time.time() < deadline:
+            hb.update(step=reads)
+            rec = resilience.read_heartbeat(p)
+            if rec is not None:
+                assert "ts" in rec and "incarnation" in rec, rec
+                reads += 1
+        hb.stop()
+        assert reads > 0, "reader never observed a complete record"
+
 
 class TestRecovery:
     def _tiny_state(self):

@@ -285,6 +285,81 @@ class TestShardPlan:
         assert ret == p.cap_ret * 64
 
 
+class TestRemeshPartition:
+    """Elastic W -> W-1 remesh (train/elastic.py) rebuilds the step over
+    the surviving mesh, which rebuilds the shard plans — the recomputed
+    owner partition must re-tile the flat unit space exactly."""
+
+    @pytest.mark.parametrize("n_units", [1, 3, 7, 10, 64, 1000])
+    def test_w4_to_w3_partition_covers_exactly(self, n_units):
+        # host-side arithmetic only: every unit owned exactly once at the
+        # old AND the new world; bounds concatenate to [0, n_units)
+        for world in (4, 3):
+            plan = wire_sharded.make_shard_plan(
+                n_units, max(n_units // 4, 1), world, 1, LOSSLESS, LOSSLESS)
+            bounds = wire_sharded.owner_bounds(plan)
+            assert len(bounds) == world
+            assert bounds[0][0] == 0 and bounds[-1][1] == n_units
+            for (_, hi), (lo, _) in zip(bounds, bounds[1:]):
+                assert hi == lo, "gap or overlap between owner shards"
+            owners = [wire_sharded.owner_of_unit(u, plan)
+                      for u in range(n_units)]
+            for u, o in enumerate(owners):
+                lo, hi = bounds[o]
+                assert lo <= u < hi, "owner_of_unit disagrees with bounds"
+            # ownership is a partition: each unit in exactly one range
+            assert sum(hi - lo for lo, hi in bounds) == n_units
+
+    def test_owner_of_unit_rejects_out_of_range(self):
+        plan = wire_sharded.make_shard_plan(10, 4, 4, 1, LOSSLESS, LOSSLESS)
+        with pytest.raises(ValueError):
+            wire_sharded.owner_of_unit(10, plan)
+        with pytest.raises(ValueError):
+            wire_sharded.owner_of_unit(-1, plan)
+
+    def test_shard_boundaries_shift_on_remesh(self):
+        # the partition is a FUNCTION of W: after 4 -> 3 the boundaries
+        # move (shard_n grows), i.e. the rebuilt step really re-partitions
+        p4 = wire_sharded.make_shard_plan(1000, 100, 4, 1, LOSSLESS, LOSSLESS)
+        p3 = wire_sharded.make_shard_plan(1000, 100, 3, 1, LOSSLESS, LOSSLESS)
+        assert p4.shard_n == 250 and p3.shard_n == 334
+        assert wire_sharded.owner_bounds(p4) != wire_sharded.owner_bounds(p3)
+
+    @pytest.mark.slow  # ~14 s dual compile; tier-1 covers the remesh path
+    def test_equivalence_at_surviving_world(self):
+        """allgather <-> sharded equivalence holds at the post-remesh W=3
+        (smaller grads than the main grid to keep the dual compile cheap);
+        the quick tier keeps the host-side partition coverage above plus
+        the chaos drill's wire+sharded remesh row — this dual-transport
+        compile and the full W cross below ride the slow tier."""
+        w = 3
+        cfg_ag, cfg_sh = cfg_pair("topk", "entiremodel", w, ratio=0.05)
+        grads = make_grads(w, n=512, n2=48)
+        o1, o2, ef1, ef2, _, s2 = run_both(mesh_of(w), cfg_ag, cfg_sh, grads)
+        for k in o1:
+            np.testing.assert_allclose(np.asarray(o1[k]), np.asarray(o2[k]),
+                                       atol=1e-6, err_msg=f"synced {k} @W=3")
+            np.testing.assert_allclose(np.asarray(ef1[k]), np.asarray(ef2[k]),
+                                       atol=1e-6, err_msg=f"EF {k} @W=3")
+        assert float(s2.get("shard_overflow", 0.0)) == 0.0
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("w", [7, 5, 3, 2])
+    def test_equivalence_full_surviving_worlds(self, w):
+        """The full cross of surviving world sizes a W=8 job can remesh
+        down through — the owner partition recomputes at each W and the
+        transports stay equivalent."""
+        cfg_ag, cfg_sh = cfg_pair("topk", "entiremodel", w, ratio=0.05)
+        grads = make_grads(w)
+        o1, o2, ef1, ef2, _, s2 = run_both(mesh_of(w), cfg_ag, cfg_sh, grads)
+        for k in o1:
+            np.testing.assert_allclose(np.asarray(o1[k]), np.asarray(o2[k]),
+                                       atol=1e-6, err_msg=f"synced {k} @W={w}")
+            np.testing.assert_allclose(np.asarray(ef1[k]), np.asarray(ef2[k]),
+                                       atol=1e-6, err_msg=f"EF {k} @W={w}")
+        assert float(s2.get("shard_overflow", 0.0)) == 0.0
+
+
 class TestSimulateCounterfactual:
     def test_simulate_bills_sharded_buckets(self, mesh8):
         """mode='simulate' + transport='sharded': the psum stays dense (the

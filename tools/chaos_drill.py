@@ -27,6 +27,24 @@ What it proves (the ISSUE 3 acceptance criteria, each as a named drill):
     identical to the uncrashed run — chaos is step-counter driven, so the
     replay reproduces the same faults.
 
+Elastic drills (the ISSUE 7 acceptance row — train/elastic.py):
+
+  * ``elastic_gossip`` — heartbeat-directory failure detection: a silent
+    peer is declared dead within ``peer_timeout``; a stale file of a dead
+    prior incarnation never refreshes liveness; a restarted peer (higher
+    incarnation) becomes a rejoin candidate.
+  * ``elastic_remesh`` — ``crash=mid_collective`` kills worker w at step N;
+    survivors convert the fault, remesh W -> W-1, and continue to
+    completion.  Replicated state (params/opt/batch stats) is bitwise the
+    pre-kill value; the EF migration matches the declared fold-or-drop
+    semantics bitwise (fold: survivor row 0 += lost row, exact fp32; drop:
+    ``elastic/dropped_ef_norm`` == the lost rows' L2, fp64-accumulated).
+  * ``elastic_readmit`` — scale back up: the parked worker rejoins at a
+    barrier with a zero EF row and PowerSGD factors broadcast-re-warmed
+    from survivor row 0, then trains at full W again.
+  * ``elastic_matrix`` — the kill-step x worker x EF-policy cross, plus a
+    wire+sharded-transport variant (the owner partition recomputes at W-1).
+
 Usage::
 
     python tools/chaos_drill.py --quick     # tier-1 smoke subset (~4 drills)
@@ -70,7 +88,8 @@ def _mesh(n=8):
     return make_data_mesh(n)
 
 
-def _tiny_setup(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9, seed=0):
+def _tiny_setup(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9, seed=0,
+                with_factory=False):
     """TinyMLP + optimizer + state + guarded train step on ``mesh``."""
     import flax.linen as nn
 
@@ -99,8 +118,17 @@ def _tiny_setup(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9, seed=0):
         comp=init_comp_state(params, comp_cfg, ndev),
         guard=init_guard_state(guard_cfg),
     )
-    step = make_train_step(make_apply_fn(module), opt, comp_cfg, mesh,
-                           guard_cfg=guard_cfg, chaos=chaos, donate=False)
+
+    def step_for(m):
+        # the elastic drills rebuild the step over the W-1 mesh — same
+        # module/opt/config, new world (the sharded transport's owner
+        # partition recomputes at trace time)
+        return make_train_step(make_apply_fn(module), opt, comp_cfg, m,
+                               guard_cfg=guard_cfg, chaos=chaos, donate=False)
+
+    step = step_for(mesh)
+    if with_factory:
+        return state, step, step_for
     return state, step
 
 
@@ -334,11 +362,157 @@ def drill_crash_recovery(mesh, *, crash_at_step=5, chaos_spec=None) -> Dict:
     return {"restores": info["restores"]}
 
 
+# ----------------------------------------------------------- elastic drills
+
+def drill_elastic_gossip(mesh=None) -> Dict:
+    """Heartbeat-gossip failure detection on a simulated clock: silence
+    past the timeout => dead (and only then); a restart (higher
+    incarnation) => rejoin candidate, never liveness of the dead life."""
+    from tpu_compressed_dp.train.elastic import (PeerFailed, PeerGossip,
+                                                 write_peer_heartbeat)
+
+    clock = {"t": 1000.0}
+    with tempfile.TemporaryDirectory() as td:
+        g = PeerGossip(td, 0, 4, peer_timeout_s=5.0, now=lambda: clock["t"])
+        for r in (1, 2, 3):
+            write_peer_heartbeat(td, r, 0, ts=clock["t"])
+        assert g.check() == {}, "fresh peers misread as dead"
+        clock["t"] += 4.0                       # rank 2 goes silent here
+        for r in (1, 3):
+            write_peer_heartbeat(td, r, 1, ts=clock["t"])
+        assert g.check() == {}, "silence below the timeout misread as death"
+        clock["t"] += 4.0                       # rank 2 now 8s stale (> 5s)
+        for r in (1, 3):
+            write_peer_heartbeat(td, r, 2, ts=clock["t"])
+        try:
+            g.raise_if_dead(step=7)
+            raise AssertionError("gossip missed the dead peer")
+        except PeerFailed as pf:
+            assert pf.failed == (2,) and pf.step == 7, pf
+        assert g.dead == (2,)
+        # the dead life's stale file keeps aging out; a RESTARTED rank 2
+        # (higher incarnation) is a rejoin candidate, not a resurrection
+        clock["t"] += 1.0
+        write_peer_heartbeat(td, 2, 0, incarnation=1, ts=clock["t"])
+        assert g.rejoin_candidates() == {2: 1}
+        assert g.dead == (2,), "rejoin candidacy must not undeclare death"
+        g.readmit(2)
+        assert g.dead == () and g.check() == {}
+    return {"detected": [2]}
+
+
+def drill_elastic_remesh(mesh, *, kill_step=2, worker=3, policy="fold",
+                         n_steps=5, transport="allgather",
+                         mode="simulate") -> Dict:
+    """Mid-collective kill of one worker => coordinated abort, W -> W-1
+    remesh, bitwise EF fold-or-drop, and the run completes on survivors."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.elastic import ElasticConfig, ElasticRuntime
+    from tpu_compressed_dp.utils.chaos import ChaosConfig, maybe_crash_injector
+
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True,
+                             mode=mode, transport=transport,
+                             granularity="entiremodel")
+    chaos = ChaosConfig.parse(
+        f"crash=mid_collective,crash_at_step={kill_step},worker={worker},"
+        f"peer_timeout=30")
+    crash = maybe_crash_injector(chaos)
+    state, step, step_for = _tiny_setup(mesh, comp, None, chaos,
+                                        with_factory=True)
+    el = ElasticRuntime(ElasticConfig(ef_policy=policy), mesh, chaos=chaos,
+                        log=lambda s: None)
+    W = int(mesh.shape["data"])
+    batch = _batch(n=56)                 # 56 divides both W=8 and W-1=7
+    i, killed = 0, False
+    while i < n_steps:
+        try:
+            crash.check(i)
+            new_state, m = step(state, batch)
+            crash.check(i, phase="mid_collective")
+        except Exception as err:
+            failure = el.failure_from(err)
+            assert failure is not None, f"unconverted fault: {err!r}"
+            assert failure.failed == (worker,) and failure.step == kill_step
+            # donate=False: the pre-dispatch state is live — the abort
+            # discards the in-flight step, exactly the declared semantics
+            pre = _snap(state)
+            old_ef = jax.device_get(state.ef)
+            state = el.handle_failure(state, failure)
+            post = _snap(state, ("params", "opt_state", "batch_stats"))
+            _assert_bitwise({k: pre[k] for k in post}, post,
+                            "elastic_remesh replicated state")
+            dropped_sq = 0.0
+            for la, lb in zip(jax.tree.leaves(old_ef),
+                              jax.tree.leaves(jax.device_get(state.ef))):
+                la, lb = np.asarray(la), np.asarray(lb)
+                expect = np.delete(la, worker, axis=0)
+                if policy == "fold":
+                    expect = expect.copy()
+                    expect[0] = expect[0] + la[worker]
+                else:
+                    dropped_sq += float(
+                        np.sum(la[worker].astype(np.float64) ** 2))
+                assert np.array_equal(expect, lb), \
+                    f"EF {policy} migration not bitwise"
+            if policy == "drop":
+                assert el.dropped_ef_norm == float(np.sqrt(dropped_sq))
+            else:
+                assert el.dropped_ef_norm == 0.0
+            assert el.world == W - 1 and el.parked == (worker,)
+            step = step_for(el.mesh)     # owner partition recomputes here
+            killed = True
+            continue
+        state = new_state
+        i += 1
+    assert killed, "mid-collective kill never fired"
+    assert int(state.step) == n_steps
+    assert el.remesh_count == 1 and el.peer_failures == 1
+    assert set(el.metrics()) == {
+        "elastic/peer_failures", "elastic/remesh_count",
+        "elastic/dropped_ef_norm", "elastic/remesh_latency_ms"}
+    for leaf in jax.tree.leaves(state.ef):
+        assert np.asarray(leaf).shape[0] == W - 1
+    return {"world": el.world, "dropped_ef_norm": el.dropped_ef_norm}
+
+
+def drill_elastic_readmit(mesh) -> Dict:
+    """Scale-up re-admission: the parked worker rejoins with a zero EF row
+    and PowerSGD factors broadcast-re-warmed from survivor row 0, then the
+    run trains at full W again."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.elastic import (ElasticConfig,
+                                                 ElasticRuntime, PeerFailed)
+
+    comp = CompressionConfig(method="powersgd", rank=2, error_feedback=True)
+    state, step, step_for = _tiny_setup(mesh, comp, None, None,
+                                        with_factory=True)
+    el = ElasticRuntime(ElasticConfig(), mesh, log=lambda s: None)
+    W = int(mesh.shape["data"])
+    batch = _batch(n=56)
+    state, _ = step(state, batch)        # warm the PowerSGD factors
+    state = el.handle_failure(state, PeerFailed((2,), step=1, reason="drill"))
+    assert el.world == W - 1 and el.parked == (2,)
+    state, _ = step_for(el.mesh)(state, batch)   # one step on survivors
+    state = el.readmit(state)
+    assert el.world == W and el.parked == ()
+    for leaf in jax.tree.leaves(jax.device_get(state.comp)):
+        a = np.asarray(leaf)
+        assert a.shape[0] == W
+        assert np.array_equal(a[-1], a[0]), "comp re-warm not a broadcast"
+    for leaf in jax.tree.leaves(jax.device_get(state.ef)):
+        assert not np.any(np.asarray(leaf)[-1]), "rejoiner EF row not zero"
+    state, _ = step_for(el.mesh)(state, batch)   # trains at full W again
+    assert int(state.step) == 3
+    return {"world": el.world, "readmits": el.readmit_count}
+
+
 # -------------------------------------------------------------------- main
 
-QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery"]
+QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery",
+         "elastic_gossip", "elastic_remesh"]
 FULL = QUICK + ["comp_hold", "ef_identity", "poison_control",
-                "skip_matrix", "ef_identity_sharded"]
+                "skip_matrix", "ef_identity_sharded",
+                "elastic_readmit", "elastic_matrix"]
 
 
 def run_drills(names, mesh=None) -> Dict[str, Dict]:
@@ -355,6 +529,23 @@ def run_drills(names, mesh=None) -> Dict[str, Dict]:
                             mesh, kind=kind, target=target, worker=worker)
                         print(f"PASS {key}")
             continue
+        if name == "elastic_matrix":
+            # kill-step x worker x EF-policy cross, plus the wire+sharded
+            # variant (owner partition recomputed over W-1)
+            for policy in ("fold", "drop"):
+                for worker in (0, 7):
+                    for kill_step in (0, 3):
+                        key = f"elastic[{policy},w{worker},s{kill_step}]"
+                        results[key] = drill_elastic_remesh(
+                            mesh, kill_step=kill_step, worker=worker,
+                            policy=policy)
+                        print(f"PASS {key}")
+            key = "elastic[sharded-wire]"
+            results[key] = drill_elastic_remesh(
+                mesh, transport="sharded", mode="wire", worker=5,
+                policy="fold")
+            print(f"PASS {key}")
+            continue
         if name == "ef_identity_sharded":
             results[name] = drill_ef_identity(mesh, transport="sharded",
                                               mode="wire")
@@ -368,7 +559,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--quick", action="store_true",
                    help="tier-1 smoke subset (skip_consistency, loss_scale, "
-                        "max_skips, crash_recovery)")
+                        "max_skips, crash_recovery, elastic_gossip, "
+                        "elastic_remesh)")
     p.add_argument("--drill", action="append", default=None,
                    help="run only the named drill(s)")
     args = p.parse_args(argv)

@@ -46,6 +46,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import os
 import subprocess
 import sys
 import time
@@ -177,9 +178,19 @@ def run_relaunch(args, cmd: List[str]) -> int:
         print("watchdog: --relaunch needs the training command after `--`")
         return 2
 
+    # seed from our own environment so a re-executed watchdog keeps the
+    # child's incarnation monotone instead of resetting it to 0
+    launches = {"n": int(os.environ.get("TCDP_RESTART_COUNT", "0") or 0)}
+
     def spawn():
+        # TCDP_RESTART_COUNT seeds the child Heartbeat's incarnation: each
+        # respawn gets a strictly larger value, so a relaunched worker's
+        # heartbeats are distinguishable from the stale file its previous
+        # life left behind (utils/resilience.Heartbeat, train/elastic.py)
+        env = dict(os.environ, TCDP_RESTART_COUNT=str(launches["n"]))
+        launches["n"] += 1
         print(f"watchdog: launching: {' '.join(cmd)}")
-        return subprocess.Popen(cmd)
+        return subprocess.Popen(cmd, env=env)
 
     return supervise(
         spawn, lambda: run_check(args),

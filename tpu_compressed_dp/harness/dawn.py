@@ -32,7 +32,7 @@ import numpy as np
 from tpu_compressed_dp.data import cifar10 as data
 from tpu_compressed_dp.harness.loop import (add_robustness_args,
                                             add_telemetry_args,
-                                            build_robustness,
+                                            build_elastic, build_robustness,
                                             make_event_stream, make_heartbeat,
                                             profile_trace, train_epoch)
 from tpu_compressed_dp.models import alexnet as alexnet_mod
@@ -424,6 +424,12 @@ def run(args) -> dict:
         args, harness="dawn", network=args.network,
         method=args.method, compress=args.compress, mode=args.mode,
         transport=args.transport, batch_size=bs, devices=ndev, epochs=epochs)
+    if getattr(args, "elastic", False) and procs > 1:
+        raise ValueError(
+            "--elastic drives the single-process simulation (one mesh "
+            "device per worker); real multi-host abort is a process exit "
+            "+ watchdog relaunch into the remesh barrier")
+    el = build_elastic(args, mesh, chaos=chaos, events=events)
     # Per-chip forward FLOPs from XLA's cost model, once (the epoch loop
     # scales it by the measured step rate — utils/flops.py conventions:
     # train = 3x fwd, MFU vs the chip's bf16 peak, omitted off-TPU).  The
@@ -444,19 +450,46 @@ def run(args) -> dict:
     # negative (the exact failure mode the watchdog reads this file for) —
     # nor a running profiler trace or an unterminated event stream
     try:
-        for epoch in range(epochs):
+        cur_train, cur_test, cur_bs = train_batches, test_batches, bs
+        epoch = 0
+        while epoch < epochs:
             profiling = args.profile_epoch == epoch and args.log_dir
             train_step = train_step_for(ratio_for_epoch(epoch))
-            with profile_trace(
-                    os.path.join(args.log_dir, "profile") if profiling else None):
-                state, epoch_stats, acc = train_epoch(
-                    train_step, eval_step, state, train_batches, test_batches,
-                    timer, bs, test_time_in_total=False,
-                    crash=crash, step_offset=int(state.step),
-                    guard_cfg=guard_cfg, timeline=timeline, world=ndev,
-                )
+            try:
+                with profile_trace(
+                        os.path.join(args.log_dir, "profile") if profiling else None):
+                    state, epoch_stats, acc = train_epoch(
+                        train_step, eval_step, state, cur_train, cur_test,
+                        timer, cur_bs, test_time_in_total=False,
+                        crash=crash, step_offset=int(state.step),
+                        guard_cfg=guard_cfg, timeline=timeline, world=ndev,
+                        elastic=el,
+                    )
+            except Exception as err:
+                failure = el.failure_from(err) if el is not None else None
+                if failure is None:
+                    raise
+                # Coordinated abort: survivors remesh from the last live
+                # TrainState (the pre-epoch buffers were donated away at
+                # step 0, so run_train_epoch rides its local out on the
+                # exception; dispatched steps drain to completion during
+                # migration) and replay the rest of the epoch.  Rebuilding
+                # the step cache on el.mesh is what recomputes the sharded
+                # transport's owner partition; the batch views are trimmed
+                # so the smaller world keeps dividing them.  Injectors fire
+                # once per process, so the replay does not re-crash.
+                state = getattr(err, "elastic_state", state)
+                state = el.handle_failure(state, failure)
+                mesh, ndev = el.mesh, el.world
+                step_cache.clear()
+                eval_step = make_eval_step(apply_fn, mesh)
+                cur_bs = (bs // ndev) * ndev
+                from tpu_compressed_dp.train.elastic import TrimBatches
+                cur_train = TrimBatches(train_batches, cur_bs)
+                cur_test = TrimBatches(test_batches, cur_bs)
+                continue
             train_time = epoch_stats["train time"]
-            examples = len(train_batches) * bs
+            examples = len(cur_train) * cur_bs
             thr = flops_mod.throughput_record(
                 fwd_flops, acc.steps / max(train_time, 1e-9),
                 examples_per_sec=examples / max(train_time, 1e-9))
@@ -471,6 +504,7 @@ def run(args) -> dict:
                                     if guard_cfg is not None else int(state.step)),
                     epoch=epoch,
                     telemetry=telemetry_snapshot(timeline),
+                    **({"elastic": el.metrics()} if el is not None else {}),
                 )
             summary = {
                 "epoch": epoch + 1,
@@ -502,15 +536,17 @@ def run(args) -> dict:
                 write_prometheus(
                     {"loss": summary["train loss"], "lr": summary["lr"],
                      **thr, **comm_means, **guard_last,
-                     **timeline.snapshot()},
+                     **timeline.snapshot(),
+                     **(el.metrics() if el is not None else {})},
                     args.prom, labels={"harness": "dawn"})
             if rank0:
                 table.append(summary)
                 tsv.append(summary)
-                tb.update_examples_count(len(train_batches) * bs)
+                tb.update_examples_count(len(cur_train) * cur_bs)
                 tb.log_metrics({f"losses/{k}": v for k, v in summary.items()
                                 if k in ("train loss", "test loss", "train acc", "test acc")})
                 tb.log_scalar("times/epoch_seconds", summary["train time"])
+            epoch += 1
         if args.log_dir and rank0:
             tsv.save(args.log_dir)
     finally:

@@ -46,6 +46,7 @@ from tpu_compressed_dp.data import imagenet as data
 from tpu_compressed_dp.harness.loop import (
     add_robustness_args,
     add_telemetry_args,
+    build_elastic,
     build_robustness,
     make_event_stream,
     make_heartbeat,
@@ -399,15 +400,21 @@ def run(args) -> Dict[str, float]:
 
     def validate(state) -> Dict[str, float]:
         # pad to the *local* static batch, then form global arrays — every
-        # process runs the same batch count (DistValSampler semantics)
+        # process runs the same batch count (DistValSampler semantics).
+        # After an elastic remesh the world may stop dividing the loader's
+        # batch, so the static eval batch is the largest world-divisible
+        # size (identical to local_bs on the launch mesh); surplus rows of
+        # a full batch are trimmed, short batches are padded+masked.
         loader = pd.val_loader
-        local_bs = loader.batch_size
+        per = int(mesh.shape["data"]) // jax.process_count()
+        eval_bs = max((loader.batch_size // per) * per, per)
 
         def batches():
             for b in _truncate(loader, 10 if args.short_epoch else None):
-                yield make_global_batch(pad_batch(b, local_bs), mesh)
+                b = {k: v[:eval_bs] for k, v in b.items()}
+                yield make_global_batch(pad_batch(b, eval_bs), mesh)
 
-        return run_eval(eval_step, state, batches(), local_bs * jax.process_count())
+        return run_eval(eval_step, state, batches(), eval_bs * jax.process_count())
 
     table, tsv = TableLogger(), TSVLogger()
     timer = Timer()
@@ -427,6 +434,12 @@ def run(args) -> Dict[str, float]:
         args, harness="imagenet", arch=args.arch, method=args.method,
         compress=args.compress, mode=args.mode, transport=args.transport,
         devices=ndev, epochs=epochs)
+    if getattr(args, "elastic", False) and jax.process_count() > 1:
+        raise ValueError(
+            "--elastic drives the single-process simulation (one mesh "
+            "device per worker); real multi-host abort is a process exit "
+            "+ watchdog relaunch into the remesh barrier")
+    el = build_elastic(args, mesh, chaos=chaos, events=events)
     # per-(size, batch) forward FLOPs from the XLA cost model — progressive
     # resizing changes the shape per phase, so cache per shape.  Skipped
     # entirely when nothing can consume the result (no exporter, no known
@@ -460,24 +473,55 @@ def run(args) -> Dict[str, float]:
             print(f"top1 {stats_val['acc']*100:.2f} top5 {stats_val['acc5']*100:.2f}")
             return stats_val
 
-        for epoch in range(start_epoch, epochs):
+        epoch = start_epoch
+        while epoch < epochs:
             swapped = pd.set_epoch(epoch)
             if swapped and ckpt and epoch > 0:
                 # phase-boundary save (`train_imagenet_nv.py:251-253`)
                 ckpt.save(state, {"epoch": epoch - 1, "phase_boundary": True})
 
             def train_batches():
+                # after a remesh the loader's batch may stop dividing the
+                # world; trim each batch to the largest divisible row count
+                per = int(mesh.shape["data"]) // jax.process_count()
                 for b in _truncate(pd.train_loader, 10 if args.short_epoch else None):
-                    yield make_global_batch(b, mesh)
+                    rows = (len(b["target"]) // per) * per
+                    if rows == 0:
+                        continue
+                    yield make_global_batch({k: v[:rows] for k, v in b.items()},
+                                            mesh)
 
             profiling = args.profile_epoch == epoch and args.logdir
-            with profile_trace(
-                    os.path.join(args.logdir, "profile") if profiling else None):
-                state, acc = run_train_epoch(train_step, state, train_batches(),
-                                             crash=crash,
-                                             step_offset=int(state.step),
-                                             guard_cfg=guard_cfg,
-                                             timeline=timeline)
+            try:
+                with profile_trace(
+                        os.path.join(args.logdir, "profile") if profiling else None):
+                    state, acc = run_train_epoch(train_step, state, train_batches(),
+                                                 crash=crash,
+                                                 step_offset=int(state.step),
+                                                 guard_cfg=guard_cfg,
+                                                 timeline=timeline,
+                                                 elastic=el)
+            except Exception as err:  # noqa: BLE001 - converted or re-raised
+                failure = el.failure_from(err) if el is not None else None
+                if failure is None:
+                    raise
+                # coordinated abort: remesh from the last live TrainState
+                # (donation consumed the pre-epoch buffers; run_train_epoch
+                # rides its local out on the exception), migrate EF/comp
+                # onto the surviving mesh, rebuild the jitted steps (the
+                # sharded transport's owner partition is a function of W
+                # and recomputes at trace time), re-run the epoch's rest
+                state = getattr(err, "elastic_state", state)
+                state = el.handle_failure(state, failure)
+                mesh, ndev = el.mesh, el.world
+                train_step = make_train_step(
+                    apply_fn, opt, comp, mesh, grad_scale=1.0,
+                    clip_norm=args.clip_norm,
+                    clip_sent_norm=args.clip_sent_norm,
+                    guard_cfg=guard_cfg, chaos=chaos)
+                eval_step = make_eval_step(apply_fn, mesh)
+                fwd_cache.clear()
+                continue
             if hb is not None:
                 hb.update(
                     step=int(state.step),
@@ -485,6 +529,7 @@ def run(args) -> Dict[str, float]:
                                     if guard_cfg is not None else int(state.step)),
                     epoch=epoch,
                     telemetry=telemetry_snapshot(timeline),
+                    **({"elastic": el.metrics()} if el is not None else {}),
                 )
             train_time = timer()
             val_stats = validate(state)
@@ -540,7 +585,8 @@ def run(args) -> Dict[str, float]:
             if args.prom and is_master:
                 write_prometheus(
                     {"loss": summary["train loss"], **thr, **comm_means,
-                     **guard_last, **timeline.snapshot()},
+                     **guard_last, **timeline.snapshot(),
+                     **(el.metrics() if el is not None else {})},
                     args.prom, labels={"harness": "imagenet"})
             # tensorboard: x-axis = cumulative examples (`logger.py:24-34`);
             # namespaces mirror the reference (losses/ times/ net/)
@@ -570,6 +616,7 @@ def run(args) -> Dict[str, float]:
             if ckpt:
                 ckpt.save_if_best(state, top5, floor=args.best_floor,
                                   meta={"epoch": epoch, "top1": top1, "top5": top5})
+            epoch += 1
         if args.logdir:
             tsv.save(args.logdir)
     finally:

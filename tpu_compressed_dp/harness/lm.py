@@ -286,6 +286,22 @@ def run(args) -> Dict[str, float]:
         method=comp.method or "none", compress=args.compress, mode=args.mode,
         transport=args.transport, seq_len=args.seq_len,
         global_batch=args.global_batch, steps=args.steps)
+    if getattr(args, "elastic", False):
+        if args.sp * args.tp * args.pp != 1:
+            raise ValueError(
+                "--elastic supports the pure data-parallel mesh; losing a "
+                "worker of a sp/tp/pp mesh orphans a model shard (that is "
+                "a checkpoint restart, not a remesh)")
+        if jax.process_count() > 1:
+            raise ValueError(
+                "--elastic drives the single-process simulation (one mesh "
+                "device per worker); real multi-host abort is a process "
+                "exit + watchdog relaunch into the remesh barrier")
+    from tpu_compressed_dp.harness.loop import build_elastic
+    from tpu_compressed_dp.train.lm_step import place_lm_state
+
+    el = build_elastic(args, mesh, chaos=chaos, events=events,
+                       place=lambda s, m: place_lm_state(s, cfg, comp, m))
     # --profile_epoch: trace the Nth log window.  ExitStack (not a `with`)
     # because the window opens and closes mid-loop; the outer finally
     # guarantees the stop even when the loop raises inside the window —
@@ -307,114 +323,156 @@ def run(args) -> Dict[str, float]:
     # trace, or an unterminated event stream; the final save stays on the
     # clean path only
     try:
-        for step_i in range(start, args.steps):
-            if prof_window is not None and step_i == prof_window[0]:
-                prof.enter_context(
-                    profile_trace(os.path.join(args.logdir, "profile")))
-            if crash is not None:
-                crash.check(step_i)
-            batch = ds.batch(step_i)
-            timeline.batch_ready()
-            state, metrics = train_step(
-                state, {k: jnp.asarray(v) for k, v in batch.items()})
-            timeline.step_dispatched()
-            if prof_window is not None and step_i + 1 == prof_window[1]:
-                prof.close()
-            if step_i <= start + 1:
-                # steady-state tokens/sec: the jitted step compiles TWICE (the
-                # donated-buffer layouts change the arg signature on call 2), so
-                # barrier-and-reset after each of the first two steps — one
-                # excluded step would leak the second compile (18s+ at 125M
-                # params) into the timed window
-                jax.device_get(metrics)
+        rows = args.global_batch        # post-remesh: largest dp-divisible cut
+        warm_until = start + 1          # compile-reset horizon (moves on remesh)
+        step_i = start
+        while step_i < args.steps:
+            try:
+                if prof_window is not None and step_i == prof_window[0]:
+                    prof.enter_context(
+                        profile_trace(os.path.join(args.logdir, "profile")))
+                if crash is not None:
+                    crash.check(step_i)
+                if el is not None:
+                    el.poll(step_i)
+                batch = ds.batch(step_i)
+                if rows != args.global_batch:
+                    batch = {k: v[:rows] for k, v in batch.items()}
+                timeline.batch_ready()
+                state, metrics = train_step(
+                    state, {k: jnp.asarray(v) for k, v in batch.items()})
+                timeline.step_dispatched()
+                if crash is not None:
+                    # the mid-collective plane: the step's collectives are
+                    # already in flight when this one fires
+                    crash.check(step_i, phase="mid_collective")
+                if prof_window is not None and step_i + 1 == prof_window[1]:
+                    prof.close()
+                if step_i <= warm_until:
+                    # steady-state tokens/sec: the jitted step compiles TWICE (the
+                    # donated-buffer layouts change the arg signature on call 2), so
+                    # barrier-and-reset after each of the first two steps — one
+                    # excluded step would leak the second compile (18s+ at 125M
+                    # params) into the timed window
+                    jax.device_get(metrics)
+                    t0 = time.time()
+                    timed_from = step_i + 1
+                    timeline.resume()  # the compile drain is not data wait
+                if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
+                    m = (el.bounded_get(metrics, step=step_i + 1)
+                         if el is not None else jax.device_get(metrics))
+                    if guard_cfg is not None:
+                        # wedge check at log cadence (detection latency = log_every)
+                        from tpu_compressed_dp.train.guard import check_guard_metrics
+
+                        guard_meter.update(m, step_i + 1)
+                        check_guard_metrics(m, guard_cfg)
+                    if hb is not None:
+                        hb.update(
+                            step=step_i + 1,
+                            last_good_step=(int(m["guard/last_good_step"])
+                                            if guard_cfg is not None else step_i + 1),
+                            telemetry=telemetry_snapshot(timeline),
+                            **({"elastic": el.metrics()} if el is not None else {}),
+                        )
+                    steps_timed = step_i + 1 - timed_from
+                    tokens_done = steps_timed * rows * args.seq_len
+                    dt = time.time() - t0
+                    summary = {
+                        "step": step_i + 1,
+                        "loss": float(m["loss"]),
+                        "lr": float(m["lr"]),
+                        # 0.0 until at least one post-compile step is in the window
+                        "tok/s": round(tokens_done / dt, 1) if steps_timed > 0 else 0.0,
+                    }
+                    thr: Dict[str, float] = {}
+                    if steps_timed > 0:
+                        # MFU (VERDICT r2 #3): closed-form 6N + 12Lds per token
+                        # (utils/flops.py), per chip, vs the chip's bf16 peak —
+                        # per-chip fwd flops feed the shared throughput_record
+                        # epilogue the CNN harnesses use
+                        from tpu_compressed_dp.utils import flops as flops_mod
+
+                        tok_flops = flops_mod.transformer_train_flops_per_token(
+                            n_params, cfg.n_layers, cfg.dim, args.seq_len)
+                        n_chips = max(int(mesh.devices.size), 1)
+                        tok_s = tokens_done / dt
+                        fwd_per_chip = (tok_flops / 3.0) * (
+                            rows * args.seq_len) / n_chips
+                        thr = flops_mod.throughput_record(
+                            fwd_per_chip, steps_timed / dt, tokens_per_sec=tok_s)
+                        if "throughput/mfu" in thr:
+                            summary["mfu"] = round(thr["throughput/mfu"], 4)
+                    comm_m = {k: float(v) for k, v in m.items()
+                              if k.startswith("comm/")}
+                    if "comm/sent_elems" in m:
+                        summary["sent frac"] = float(m["comm/sent_elems"]) / max(
+                            float(m["comm/dense_elems"]), 1.0)
+                        summary["wire frac"] = float(m["comm/sent_bits"]) / (
+                            32.0 * max(float(m["comm/dense_elems"]), 1.0))
+                        per_chip_b = per_chip_comm_bytes(comm_m, world)
+                        if per_chip_b is not None and steps_timed > 0:
+                            summary["comm MB/s"] = round(
+                                per_chip_b * (steps_timed / dt) / 1e6, 3)
+                    guard_last = {k: float(v) for k, v in m.items()
+                                  if k.startswith("guard/")}
+                    if guard_cfg is not None:
+                        gsum = guard_meter.summary()
+                        summary["skipped"] = gsum.get("guard/skipped", 0.0)
+                        summary["loss_scale"] = gsum.get("guard/loss_scale", 1.0)
+                    if events is not None:
+                        events.emit(
+                            "step", step=step_i + 1,
+                            metrics={k: v for k, v in summary.items()
+                                     if isinstance(v, (int, float))},
+                            throughput=thr, comm=comm_m, guard=guard_last,
+                            timeline=timeline.snapshot(),
+                            step_spans=timeline.drain())
+                        # delta-gate on the cumulative counter: one guard event
+                        # per window that actually skipped, not one per window
+                        # forever after the first skip
+                        skipped_now = guard_last.get("guard/skipped", 0.0)
+                        if skipped_now > prev_skipped:
+                            events.emit("guard", step=step_i + 1, **guard_last)
+                        prev_skipped = skipped_now
+                    if args.prom and jax.process_index() == 0:
+                        write_prometheus(
+                            {"loss": summary["loss"], "lr": summary["lr"],
+                             **thr, **comm_m, **guard_last,
+                             **timeline.snapshot(),
+                             **(el.metrics() if el is not None else {})},
+                            args.prom, labels={"harness": "lm"})
+                    table.append(summary)
+                    # the log window's device_get drain + export work is not the
+                    # next step's input-pipeline wait
+                    timeline.resume()
+            except Exception as err:  # noqa: BLE001 - converted or re-raised
+                failure = el.failure_from(err) if el is not None else None
+                if failure is None:
+                    raise
+                # coordinated abort + remesh.  Granularity is one step: a
+                # pre-dispatch detection (gossip poll) retries the same
+                # index untouched; a post-dispatch kill drains the in-flight
+                # step during migration (single-process simulation — the
+                # collectives do complete) and the index re-runs on the W-1
+                # mesh.  Real multi-host discards in-flight work by process
+                # exit instead.
+                state = el.handle_failure(state, failure)
+                mesh = el.mesh
+                dp = el.world
+                world = dp * args.sp
+                rows = (args.global_batch // dp) * dp
+                train_step = make_lm_train_step(
+                    cfg, opt, comp, mesh,
+                    clip_norm=args.clip_norm,
+                    clip_sent_norm=args.clip_sent_norm,
+                    guard_cfg=guard_cfg, chaos=chaos)
+                warm_until = step_i + 1     # fresh compile pair on the new mesh
                 t0 = time.time()
-                timed_from = step_i + 1
-                timeline.resume()  # the compile drain is not data wait
-            if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
-                m = jax.device_get(metrics)
-                if guard_cfg is not None:
-                    # wedge check at log cadence (detection latency = log_every)
-                    from tpu_compressed_dp.train.guard import check_guard_metrics
-
-                    guard_meter.update(m, step_i + 1)
-                    check_guard_metrics(m, guard_cfg)
-                if hb is not None:
-                    hb.update(
-                        step=step_i + 1,
-                        last_good_step=(int(m["guard/last_good_step"])
-                                        if guard_cfg is not None else step_i + 1),
-                        telemetry=telemetry_snapshot(timeline),
-                    )
-                steps_timed = step_i + 1 - timed_from
-                tokens_done = steps_timed * args.global_batch * args.seq_len
-                dt = time.time() - t0
-                summary = {
-                    "step": step_i + 1,
-                    "loss": float(m["loss"]),
-                    "lr": float(m["lr"]),
-                    # 0.0 until at least one post-compile step is in the window
-                    "tok/s": round(tokens_done / dt, 1) if steps_timed > 0 else 0.0,
-                }
-                thr: Dict[str, float] = {}
-                if steps_timed > 0:
-                    # MFU (VERDICT r2 #3): closed-form 6N + 12Lds per token
-                    # (utils/flops.py), per chip, vs the chip's bf16 peak —
-                    # per-chip fwd flops feed the shared throughput_record
-                    # epilogue the CNN harnesses use
-                    from tpu_compressed_dp.utils import flops as flops_mod
-
-                    tok_flops = flops_mod.transformer_train_flops_per_token(
-                        n_params, cfg.n_layers, cfg.dim, args.seq_len)
-                    n_chips = max(len(jax.devices()), 1)
-                    tok_s = tokens_done / dt
-                    fwd_per_chip = (tok_flops / 3.0) * (
-                        args.global_batch * args.seq_len) / n_chips
-                    thr = flops_mod.throughput_record(
-                        fwd_per_chip, steps_timed / dt, tokens_per_sec=tok_s)
-                    if "throughput/mfu" in thr:
-                        summary["mfu"] = round(thr["throughput/mfu"], 4)
-                comm_m = {k: float(v) for k, v in m.items()
-                          if k.startswith("comm/")}
-                if "comm/sent_elems" in m:
-                    summary["sent frac"] = float(m["comm/sent_elems"]) / max(
-                        float(m["comm/dense_elems"]), 1.0)
-                    summary["wire frac"] = float(m["comm/sent_bits"]) / (
-                        32.0 * max(float(m["comm/dense_elems"]), 1.0))
-                    per_chip_b = per_chip_comm_bytes(comm_m, world)
-                    if per_chip_b is not None and steps_timed > 0:
-                        summary["comm MB/s"] = round(
-                            per_chip_b * (steps_timed / dt) / 1e6, 3)
-                guard_last = {k: float(v) for k, v in m.items()
-                              if k.startswith("guard/")}
-                if guard_cfg is not None:
-                    gsum = guard_meter.summary()
-                    summary["skipped"] = gsum.get("guard/skipped", 0.0)
-                    summary["loss_scale"] = gsum.get("guard/loss_scale", 1.0)
-                if events is not None:
-                    events.emit(
-                        "step", step=step_i + 1,
-                        metrics={k: v for k, v in summary.items()
-                                 if isinstance(v, (int, float))},
-                        throughput=thr, comm=comm_m, guard=guard_last,
-                        timeline=timeline.snapshot(),
-                        step_spans=timeline.drain())
-                    # delta-gate on the cumulative counter: one guard event
-                    # per window that actually skipped, not one per window
-                    # forever after the first skip
-                    skipped_now = guard_last.get("guard/skipped", 0.0)
-                    if skipped_now > prev_skipped:
-                        events.emit("guard", step=step_i + 1, **guard_last)
-                    prev_skipped = skipped_now
-                if args.prom and jax.process_index() == 0:
-                    write_prometheus(
-                        {"loss": summary["loss"], "lr": summary["lr"],
-                         **thr, **comm_m, **guard_last,
-                         **timeline.snapshot()},
-                        args.prom, labels={"harness": "lm"})
-                table.append(summary)
-                # the log window's device_get drain + export work is not the
-                # next step's input-pipeline wait
+                timed_from = step_i
                 timeline.resume()
+                continue
+            step_i += 1
         if ckpt:
             ckpt.save(state, {"step": int(state.step)})
     finally:

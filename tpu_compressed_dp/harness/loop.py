@@ -21,8 +21,8 @@ from tpu_compressed_dp.utils.timer import Timer
 
 __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
            "comm_summary", "guard_summary", "add_robustness_args",
-           "add_telemetry_args", "build_robustness", "make_heartbeat",
-           "make_event_stream", "profile_trace"]
+           "add_telemetry_args", "build_robustness", "build_elastic",
+           "make_heartbeat", "make_event_stream", "profile_trace"]
 
 
 @contextlib.contextmanager
@@ -92,6 +92,26 @@ def add_robustness_args(p, *, check_note: str) -> None:
                    help="liveness JSON path (utils/resilience.Heartbeat); "
                         "payload carries step + last_good_step")
     p.add_argument("--heartbeat_interval", type=float, default=10.0)
+    p.add_argument("--elastic", action="store_true",
+                   help="survive peer death without a full-job restart: "
+                        "detect (heartbeat gossip + bounded fetches), "
+                        "remesh to W-1 with EF/PowerSGD migration, retry "
+                        "(train/elastic.py)")
+    p.add_argument("--elastic_dir", type=str, default=None,
+                   help="shared per-rank heartbeat gossip directory "
+                        "(omit = no gossip plane; chaos/fetch detection "
+                        "still active)")
+    p.add_argument("--peer_timeout", type=float, default=60.0,
+                   help="seconds without a fresh peer heartbeat (or a "
+                        "blocked metrics fetch) before declaring the peer "
+                        "dead; --chaos peer_timeout=<s> overrides")
+    p.add_argument("--elastic_ef", type=str, default="fold",
+                   choices=("fold", "drop"),
+                   help="departing worker's EF residual: fold into a "
+                        "survivor (mass-conserving) or drop and count it "
+                        "in elastic/dropped_ef_norm")
+    p.add_argument("--elastic_min_world", type=int, default=2,
+                   help="refuse to remesh below this many workers")
 
 
 def make_heartbeat(args):
@@ -129,6 +149,42 @@ def build_robustness(args, dtype):
         max_consecutive_skips=args.guard_max_skips,
     ) if want_guard else None
     return guard_cfg, chaos, maybe_crash_injector(chaos)
+
+
+def build_elastic(args, mesh, *, chaos=None, events=None, place=None):
+    """Resolve the ``--elastic*`` CLI surface into a started
+    :class:`~tpu_compressed_dp.train.elastic.ElasticRuntime` (or None).
+
+    The gossip plane only arms when ``--elastic_dir`` names the shared
+    directory; the chaos-conversion and bounded-fetch detection planes are
+    always on.  ``--chaos peer_timeout=<s>`` (the drill's knob) overrides
+    ``--peer_timeout``.  Raises on non-data meshes — elastic remesh is a
+    data-parallel membership change; sp/tp/pp meshes would need resharding
+    model state too.
+    """
+    if not getattr(args, "elastic", False):
+        return None
+    from tpu_compressed_dp.train.elastic import (ElasticConfig,
+                                                 ElasticRuntime, PeerGossip)
+
+    timeout = args.peer_timeout
+    if chaos is not None and chaos.peer_timeout > 0:
+        timeout = chaos.peer_timeout
+    cfg = ElasticConfig(
+        gossip_dir=args.elastic_dir, rank=jax.process_index(),
+        peer_timeout_s=timeout, min_world=args.elastic_min_world,
+        ef_policy=args.elastic_ef)
+    gossip = None
+    if cfg.gossip_dir:
+        # gossip is a PROCESS-level plane: one rank per host process, each
+        # writing its own liveness file (ElasticRuntime.poll beats it).
+        # Under the single-process simulation world == 1 — the simulated
+        # per-device workers have no writers, so peer death there is the
+        # chaos plane's job (drills simulate gossip peers directly).
+        gossip = PeerGossip(cfg.gossip_dir, cfg.rank, jax.process_count(),
+                            peer_timeout_s=cfg.peer_timeout_s)
+    return ElasticRuntime(cfg, mesh, chaos=chaos, gossip=gossip,
+                          events=events, place=place)
 
 
 def comm_summary(acc: "MetricAccumulator") -> Dict[str, float]:
@@ -176,7 +232,7 @@ def pad_batch(batch: Dict[str, np.ndarray], size: int) -> Dict[str, np.ndarray]:
 
 def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
                     *, crash=None, step_offset: int = 0, guard_cfg=None,
-                    timeline=None,
+                    timeline=None, elastic=None,
                     ) -> Tuple[TrainState, MetricAccumulator]:
     # Metrics stay on device until the epoch ends: a per-step float() would
     # block host batch prep on the device and serialize the pipeline (JAX's
@@ -195,22 +251,47 @@ def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
     # ``timeline`` (obs/trace.StepTimeline) splits each step's host time
     # into input-pipeline wait (the `next()` inside the for statement) and
     # dispatch; it never syncs the device unless configured to sample.
+    #
+    # ``elastic`` (train/elastic.ElasticRuntime) adds the per-batch gossip
+    # poll and the second crash check AFTER dispatch (phase
+    # 'mid_collective': the step's collectives are in flight — the
+    # deterministic stand-in for a peer dying inside an allreduce), and
+    # bounds the epoch-end metrics fetch so a dead peer raises PeerFailed
+    # instead of stalling the fetch forever.
     acc = MetricAccumulator()
     step_metrics = []
     if timeline is not None:
         # exclude whatever happened since the previous epoch's last dispatch
         # (eval, checkpoint saves, loader swaps) from step 0's data wait
         timeline.resume()
-    for i, batch in enumerate(batches):
-        if timeline is not None:
-            timeline.batch_ready()
-        if crash is not None:
-            crash.check(step_offset + i)
-        state, metrics = train_step(state, {k: jnp.asarray(v) for k, v in batch.items()})
-        if timeline is not None:
-            timeline.step_dispatched()
-        step_metrics.append(metrics)
-    fetched = jax.device_get(step_metrics)
+    try:
+        for i, batch in enumerate(batches):
+            if timeline is not None:
+                timeline.batch_ready()
+            if crash is not None:
+                crash.check(step_offset + i)
+            if elastic is not None:
+                elastic.poll(step_offset + i)
+            state, metrics = train_step(state, {k: jnp.asarray(v) for k, v in batch.items()})
+            if crash is not None:
+                crash.check(step_offset + i, phase="mid_collective")
+            if timeline is not None:
+                timeline.step_dispatched()
+            step_metrics.append(metrics)
+    except Exception as err:
+        # donation consumed the caller's pre-epoch buffers at step 0, so
+        # the only live TrainState is this frame's local — ride it out on
+        # the exception for the elastic remesh handler (steps dispatched
+        # before the failure drain to completion during state migration
+        # under the single-process simulation; the rest of the epoch
+        # re-runs on the surviving mesh)
+        err.elastic_state = state
+        raise
+    if elastic is not None:
+        fetched = elastic.bounded_get(step_metrics,
+                                      step=step_offset + len(step_metrics))
+    else:
+        fetched = jax.device_get(step_metrics)
     for metrics in fetched:
         acc.update(metrics)
     if guard_cfg is not None and fetched:
@@ -250,6 +331,7 @@ def train_epoch(
     guard_cfg=None,
     timeline=None,
     world: Optional[int] = None,
+    elastic=None,
 ) -> Tuple[TrainState, Dict[str, float], MetricAccumulator]:
     """One train + eval pass with the reference's epoch-summary shape
     (`core.py:324-331`).  ``crash``/``step_offset``/``guard_cfg``/
@@ -261,7 +343,8 @@ def train_epoch(
     the reduction."""
     state, train_acc = run_train_epoch(
         train_step, state, train_batches, crash=crash,
-        step_offset=step_offset, guard_cfg=guard_cfg, timeline=timeline)
+        step_offset=step_offset, guard_cfg=guard_cfg, timeline=timeline,
+        elastic=elastic)
     train_time = timer()
     test_stats = run_eval(eval_step, state, test_batches, batch_size)
     test_time = timer(test_time_in_total)

@@ -158,6 +158,19 @@ declare("time/data_wait_frac", GAUGE, "ratio", "mean", "host",
 declare("time/steps_per_sec", GAUGE, "steps/s", "mean", "host",
         "host-observed step rate over the timeline window")
 
+# --- elastic runtime (train/elastic.py; every survivor derives identical
+#     values from the same coordinated failure, hence max = identity) ----
+declare("elastic/peer_failures", COUNTER, "workers", "max", "host",
+        "workers declared dead over the run (gossip, fetch timeout, or "
+        "chaos mid-collective kill)")
+declare("elastic/remesh_count", COUNTER, "remeshes", "max", "host",
+        "completed W -> W-1 (or readmission) remesh barriers")
+declare("elastic/dropped_ef_norm", COUNTER, "l2", "max", "host",
+        "L2 norm of departed workers' EF residual mass discarded under "
+        "the drop policy (0 under fold)")
+declare("elastic/remesh_latency_ms", TIMING, "ms", "mean", "host",
+        "host latency of the latest remesh (state migration + re-place)")
+
 
 def canonical(key: str) -> str:
     """Map a raw engine stat key to its canonical registry name.

@@ -57,7 +57,8 @@ import jax.numpy as jnp
 Array = jax.Array
 
 __all__ = ["ShardPlan", "make_shard_plan", "sharded_payload_bits",
-           "sharded_combine", "SHARDED_METHODS"]
+           "sharded_combine", "owner_of_unit", "owner_bounds",
+           "SHARDED_METHODS"]
 
 # The wire methods whose payloads carry explicit indices and therefore have
 # a sharded form.  Quantizers (terngrad/qsgd) ship dense per-worker codes
@@ -111,6 +112,36 @@ def make_shard_plan(n_units: int, keep: int, world: int, unit_size: int,
     dense_bits = shard_n * 32 * unit_size
     return ShardPlan(n_units, keep, world, unit_size, shard_n, cap_dest,
                      cap_ret, dense_bits <= sparse_bits)
+
+
+def owner_of_unit(unit: int, plan: ShardPlan) -> int:
+    """Which worker owns flat unit ``unit`` — the host-side mirror of the
+    routing rule inside :func:`sharded_combine` (``min(u // shard_n, W-1)``,
+    the clamp absorbing the ragged last shard when ``W*shard_n > n_units``).
+    Pure arithmetic on the plan: an elastic remesh that rebuilds the step
+    over W-1 workers gets a new plan and with it a new partition, and the
+    tests (tests/test_wire_sharded.py) check the two stay consistent."""
+    if not 0 <= unit < plan.n_units:
+        raise ValueError(f"unit {unit} outside [0, {plan.n_units})")
+    return min(unit // plan.shard_n, plan.world - 1)
+
+
+def owner_bounds(plan: ShardPlan) -> Tuple[Tuple[int, int], ...]:
+    """Per-owner ``(lo, hi)`` half-open unit ranges, in owner order.
+
+    Concatenated they tile ``[0, n_units)`` exactly — no unit unowned, none
+    doubly-owned — for EVERY world size, including the ragged tails where
+    the last owners hold short or empty shards (e.g. ``n_units=10, W=4``:
+    shard_n=3, ranges (0,3)(3,6)(6,9)(9,10)).  This is the invariant a
+    W -> W-1 remesh must re-establish and the partition-coverage tests
+    assert directly."""
+    bounds = []
+    for w in range(plan.world):
+        lo = min(w * plan.shard_n, plan.n_units)
+        hi = plan.n_units if w == plan.world - 1 else min(
+            (w + 1) * plan.shard_n, plan.n_units)
+        bounds.append((lo, hi))
+    return tuple(bounds)
 
 
 def sharded_payload_bits(n_units: int, keep: int, world: int, unit_size: int,

@@ -1,0 +1,692 @@
+"""Elastic data-parallel training: failure detection, coordinated abort,
+W-1 remesh with EF/PowerSGD state migration, and scale-up re-admission.
+
+The robustness stack up to here survives nonfinite steps (the step guard)
+and single-process death (checkpoint + watchdog relaunch) — but only by
+restarting the WHOLE job: a dead host stalls every collective until the
+supervisor kills the world.  This module is the other half: survivors
+detect the failure, abort coherently, shrink the mesh by the dead worker,
+and keep training.
+
+Failure model (three detection planes, all raising :class:`PeerFailed`):
+
+  * **heartbeat gossip** (:class:`PeerGossip`) — every worker process
+    writes its own liveness file (:meth:`PeerGossip.beat`, same atomic
+    record shape as :class:`~tpu_compressed_dp.utils.resilience.Heartbeat`)
+    into a shared ``--elastic_dir``; every worker reads its peers' files
+    each poll.  A peer whose record stays older than ``peer_timeout_s`` is
+    dead.  Records carry an ``incarnation`` (seeded from
+    ``TCDP_RESTART_COUNT``, exported by ``tools/watchdog.py --relaunch``):
+    a restarted peer's fresh file has a HIGHER incarnation, so it reads as
+    "this rank died and came back" (a rejoin candidate), never as
+    continuity of the dead life.
+  * **bounded collective fetch** (:func:`fetch_with_timeout`) — a
+    ``device_get`` on results of an in-flight step normally returns in
+    step time; when a peer died mid-collective it blocks forever.  The
+    fetch runs in a worker thread with a deadline; blowing it raises
+    ``PeerFailed`` instead of stalling silently.  (Honest limitation: an
+    in-process XLA computation cannot be cancelled — on real multi-host
+    deployments the abort is a process exit and the watchdog relaunches
+    into the next remesh barrier; under the single-process simulation the
+    deterministic ``crash=mid_collective`` chaos plays the dying peer.)
+  * **deterministic chaos** — ``--chaos crash=mid_collective,...`` raises
+    after step dispatch, while the step's collectives are in flight;
+    :meth:`ElasticRuntime.failure_from` translates it into the same
+    ``PeerFailed`` the real detectors raise, which is what lets the chaos
+    drill prove the whole remesh path bitwise.
+
+Remesh semantics (what the departing worker owes the run):
+
+  * ``params`` / ``opt_state`` / ``batch_stats`` / ``guard`` are replicated
+    — survivors already hold them; they are preserved **bitwise**.
+  * ``TrainState.ef`` is per-worker unsent gradient mass (the memory of
+    "Sparsified SGD with Memory"): the lost worker's residual row is either
+    **folded** into a survivor's residual (an exact fp32 add — total EF
+    mass is conserved, and the folded mass re-enters the very next step's
+    gradients like any EF carry) or **dropped** and accounted in the
+    ``elastic/dropped_ef_norm`` metric (the L2 norm of the gradient mass
+    the run will never apply).
+  * ``TrainState.comp`` (PowerSGD warm-start factors) is identical on
+    every worker by construction (the P/Q psums average factors), so the
+    dead worker's rows are simply deleted; on re-admission the returning
+    worker's factors are re-warmed from a broadcast of a survivor's row —
+    re-agreement is what keeps the power iteration meaningful.
+  * The sharded transport's owner partition (``ops/wire_sharded.py``) is a
+    pure function of the static world size read off the mesh at trace
+    time, so rebuilding the train step over the W-1 mesh recomputes the
+    shard boundaries automatically (tests/test_wire_sharded.py asserts the
+    W -> W-1 partition keeps covering the flat unit space exactly).
+
+Scale-up: a returning host rejoins at the next remesh barrier
+(:meth:`ElasticRuntime.readmit`): the mesh is extended with the parked
+device, the live (in-process) state plays the role of the live checkpoint,
+the new EF row starts at zero (a fresh worker has not withheld anything)
+and the comp rows are broadcast-re-warmed.
+
+``tools/chaos_drill.py`` (``elastic_remesh`` / ``elastic_readmit`` /
+``elastic_matrix``) proves the invariants end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from tpu_compressed_dp.parallel.mesh import DATA_AXIS, make_data_mesh
+from tpu_compressed_dp.utils.resilience import read_heartbeat
+
+__all__ = [
+    "PeerFailed", "ElasticConfig", "PeerGossip", "ElasticRuntime",
+    "heartbeat_path", "write_peer_heartbeat", "fetch_with_timeout",
+    "surviving_mesh", "extended_mesh", "migrate_ef", "migrate_comp",
+    "expand_ef", "expand_comp", "shrink_state", "expand_state",
+    "TrimBatches",
+]
+
+#: Default failure-detection budget: a peer heartbeat older than this (and
+#: a collective fetch blocked longer than this) counts as a dead peer.
+DEFAULT_PEER_TIMEOUT_S = 60.0
+
+
+class PeerFailed(RuntimeError):
+    """Coordinated abort signal: one or more peers are gone.
+
+    ``failed`` — worker indices (mesh positions / gossip ranks) declared
+    dead; may be empty when a collective timeout fired before the gossip
+    named a culprit (the runtime then consults gossip to fill it in).
+    ``step`` — the attempted global step, when known.  Every survivor
+    raises the same verdict from the same evidence (stale files age out at
+    the same wall-clock deadline; the chaos injection is step-keyed), which
+    is what makes the abort coordinated rather than a stampede.
+    """
+
+    def __init__(self, failed: Iterable[int] = (), *,
+                 step: Optional[int] = None, reason: str = "peer failure"):
+        self.failed: Tuple[int, ...] = tuple(sorted(int(f) for f in failed))
+        self.step = None if step is None else int(step)
+        self.reason = reason
+        who = list(self.failed) if self.failed else "unknown peer(s)"
+        at = f" at step {self.step}" if self.step is not None else ""
+        super().__init__(f"elastic: {who} failed{at}: {reason}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Knobs of the elastic runtime (CLI surface: ``--elastic*``).
+
+    gossip_dir:      shared directory of per-rank heartbeat files (None =
+                     no gossip plane; chaos / fetch timeouts still work)
+    rank:            this worker's gossip rank
+    peer_timeout_s:  staleness/fetch deadline before a peer counts as dead
+    min_world:       refuse to shrink below this many workers (the job is
+                     better off dying and relaunching than limping on a
+                     mesh too small to be worth the lr/batch mismatch)
+    ef_policy:       'fold' (conserve the lost EF mass into a survivor) |
+                     'drop' (discard it; counted in elastic/dropped_ef_norm)
+    """
+
+    gossip_dir: Optional[str] = None
+    rank: int = 0
+    peer_timeout_s: float = DEFAULT_PEER_TIMEOUT_S
+    min_world: int = 2
+    ef_policy: str = "fold"
+
+    def __post_init__(self):
+        if self.ef_policy not in ("fold", "drop"):
+            raise ValueError(
+                f"ef_policy must be fold|drop, got {self.ef_policy!r}")
+        if self.peer_timeout_s <= 0:
+            raise ValueError("peer_timeout_s must be > 0")
+        if self.min_world < 1:
+            raise ValueError("min_world must be >= 1")
+
+
+# ------------------------------------------------------------------ gossip
+
+def heartbeat_path(gossip_dir: str, rank: int) -> str:
+    return os.path.join(gossip_dir, f"rank{int(rank)}.json")
+
+
+def write_peer_heartbeat(gossip_dir: str, rank: int, step: int, *,
+                         incarnation: int = 0,
+                         ts: Optional[float] = None) -> str:
+    """One atomic heartbeat write into the gossip directory — the
+    thread-free form the harness step loops and the drill's simulated
+    peers use (same record shape and atomic tmp+replace as
+    :class:`~tpu_compressed_dp.utils.resilience.Heartbeat`)."""
+    os.makedirs(gossip_dir, exist_ok=True)
+    path = heartbeat_path(gossip_dir, rank)
+    rec = {"ts": time.time() if ts is None else float(ts),
+           "step": int(step), "rank": int(rank),
+           "incarnation": int(incarnation)}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
+    return path
+
+
+class PeerGossip:
+    """Decentralised failure detector over a shared heartbeat directory.
+
+    Each worker runs one instance: it reads every peer's file per
+    :meth:`check` and votes a peer dead once no FRESH record (recent ``ts``
+    AND the admitted incarnation) has been seen for ``peer_timeout_s``.
+    Incarnation rules:
+
+      * the first record seen for a rank admits its incarnation;
+      * a record with a LOWER incarnation than admitted is a stale file of
+        a dead prior life — it never refreshes liveness;
+      * a record with a HIGHER incarnation means the peer process was
+        replaced: the admitted life is declared dead (its in-memory EF row
+        is gone regardless of how alive the new process looks) and the new
+        incarnation becomes a rejoin candidate for the next barrier.
+
+    Construction starts every peer's grace clock at "now", so a cold start
+    where peers appear over ``peer_timeout_s`` does not false-positive.
+    """
+
+    def __init__(self, gossip_dir: str, rank: int, world: int, *,
+                 peer_timeout_s: float = DEFAULT_PEER_TIMEOUT_S,
+                 incarnation: Optional[int] = None,
+                 now: Callable[[], float] = time.time):
+        self.gossip_dir = gossip_dir
+        self.rank = int(rank)
+        self.world = int(world)
+        self.peer_timeout_s = float(peer_timeout_s)
+        if incarnation is None:
+            try:
+                incarnation = int(os.environ.get("TCDP_RESTART_COUNT", "0"))
+            except ValueError:
+                incarnation = 0
+        self.incarnation = int(incarnation)
+        self._last_beat = float("-inf")
+        self._now = now
+        t0 = now()
+        self._last_fresh: Dict[int, float] = {
+            r: t0 for r in range(self.world)}
+        self._admitted: Dict[int, Optional[int]] = {
+            r: None for r in range(self.world)}
+        self._dead: Dict[int, str] = {}          # rank -> reason
+        self._rejoin: Dict[int, int] = {}        # rank -> new incarnation
+
+    @property
+    def dead(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._dead))
+
+    def beat(self, step: int = 0) -> None:
+        """Write THIS rank's own liveness file (rate-limited to a quarter of
+        the timeout — peers need several fresh observations per window, and
+        an atomic replace per step would be pure filesystem churn)."""
+        now = self._now()
+        if now - self._last_beat >= self.peer_timeout_s / 4:
+            write_peer_heartbeat(self.gossip_dir, self.rank, step,
+                                 incarnation=self.incarnation, ts=now)
+            self._last_beat = now
+
+    def note_dead(self, ranks: Iterable[int], reason: str = "declared dead"
+                  ) -> None:
+        """Record an externally-detected failure (chaos conversion, a peer
+        named by another detector) so rejoin tracking stays consistent."""
+        for r in ranks:
+            self._dead.setdefault(int(r), reason)
+
+    def check(self, now: Optional[float] = None) -> Dict[int, str]:
+        """One gossip sweep; returns the NEWLY dead peers ``{rank: why}``
+        (already-known dead peers are only re-reported via :attr:`dead`)."""
+        now = self._now() if now is None else now
+        newly: Dict[int, str] = {}
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            hb = read_heartbeat(heartbeat_path(self.gossip_dir, r))
+            inc = None
+            if hb is not None:
+                inc = int(hb.get("incarnation", 0) or 0)
+                ts = hb.get("ts")
+                fresh_ts = (isinstance(ts, (int, float))
+                            and not isinstance(ts, bool)
+                            and (now - ts) <= self.peer_timeout_s)
+            if r in self._dead:
+                dead_inc = self._admitted.get(r)
+                if (hb is not None and fresh_ts and inc is not None
+                        and (dead_inc is None or inc > dead_inc)):
+                    self._rejoin[r] = inc
+                continue
+            if hb is not None:
+                if self._admitted[r] is None:
+                    self._admitted[r] = inc
+                if inc > self._admitted[r]:
+                    # the process we were tracking is gone; its replacement
+                    # may rejoin, but the tracked life's state died with it
+                    why = (f"incarnation advanced {self._admitted[r]} -> "
+                           f"{inc} (peer restarted)")
+                    self._dead[r] = why
+                    newly[r] = why
+                    self._rejoin[r] = inc
+                    continue
+                if fresh_ts and inc == self._admitted[r]:
+                    self._last_fresh[r] = max(self._last_fresh[r], float(ts))
+            age = now - self._last_fresh[r]
+            if age > self.peer_timeout_s:
+                why = (f"no fresh heartbeat for {age:.1f}s "
+                       f"(> {self.peer_timeout_s:g}s)")
+                self._dead[r] = why
+                newly[r] = why
+        return newly
+
+    def raise_if_dead(self, step: Optional[int] = None,
+                      now: Optional[float] = None) -> None:
+        newly = self.check(now)
+        if newly:
+            reason = "; ".join(f"rank {r}: {why}"
+                               for r, why in sorted(newly.items()))
+            raise PeerFailed(newly, step=step, reason=reason)
+
+    def rejoin_candidates(self, now: Optional[float] = None
+                          ) -> Dict[int, int]:
+        """Dead ranks whose directory now shows a fresh, newer incarnation
+        — ready for re-admission at the next barrier."""
+        self.check(now)
+        return dict(self._rejoin)
+
+    def readmit(self, rank: int) -> None:
+        """Move a rank back to the tracked set under its new incarnation."""
+        rank = int(rank)
+        inc = self._rejoin.pop(rank, None)
+        self._dead.pop(rank, None)
+        self._admitted[rank] = inc
+        self._last_fresh[rank] = self._now()
+
+
+# ------------------------------------------------- bounded collective fetch
+
+def fetch_with_timeout(thunk: Callable[[], Any], timeout_s: float, *,
+                       step: Optional[int] = None,
+                       what: str = "collective fetch") -> Any:
+    """Run a blocking device fetch with a deadline.
+
+    ``jax.device_get`` on an in-flight step's outputs normally completes in
+    step time; with a peer dead mid-collective it blocks forever.  The
+    thunk runs in a daemon thread; exceeding ``timeout_s`` raises
+    :class:`PeerFailed` (with no culprit — gossip names the rank).  The
+    thunk's own exception, if any, is re-raised on the caller's thread.
+    """
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def runner():
+        try:
+            box["value"] = thunk()
+        except BaseException as e:  # surfaced on the caller's thread
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        raise PeerFailed((), step=step, reason=(
+            f"{what} still blocked after {timeout_s:g}s — "
+            "a peer died mid-collective"))
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+# ------------------------------------------------------------ mesh surgery
+
+def _data_devices(mesh) -> List:
+    """Devices along the data axis, requiring a data-parallel-ONLY mesh:
+    either the 1-D ``('data',)`` mesh or a multi-axis mesh whose non-data
+    axes are all size 1 (the LM harness's dp-only configuration).  Losing
+    one data worker of a sheared dp x tp mesh would orphan a whole model
+    shard — that is a job restart, not a remesh."""
+    names = tuple(mesh.axis_names)
+    if DATA_AXIS not in names:
+        raise ValueError(
+            f"elastic remesh needs a '{DATA_AXIS}' axis; got axes {names}")
+    extra = {n: int(mesh.shape[n]) for n in names if n != DATA_AXIS}
+    if any(s != 1 for s in extra.values()):
+        raise ValueError(
+            "elastic remesh supports data-parallel-only meshes; got "
+            f"model axes {extra}")
+    return list(mesh.devices.reshape(-1))
+
+
+def _rebuild_mesh(mesh, devices: Sequence):
+    """A mesh over ``devices`` with the template mesh's axis names (data
+    axis resized, unit model axes preserved so the harness's specs keep
+    resolving)."""
+    names = tuple(mesh.axis_names)
+    if names == (DATA_AXIS,):
+        return make_data_mesh(devices=list(devices))
+    shape = tuple(len(devices) if n == DATA_AXIS else 1 for n in names)
+    return jax.sharding.Mesh(
+        np.asarray(devices, dtype=object).reshape(shape), names)
+
+
+def surviving_mesh(mesh, failed: Sequence[int]):
+    """The W-1 (or W-F) mesh over the survivors, order preserved; returns
+    ``(new_mesh, removed_devices)`` with the dead workers' devices parked
+    for later re-admission."""
+    devices = _data_devices(mesh)
+    failed_set = {int(f) for f in failed}
+    bad = [f for f in failed_set if not 0 <= f < len(devices)]
+    if bad:
+        raise ValueError(f"failed worker index {bad} outside world "
+                         f"{len(devices)}")
+    survivors = [d for i, d in enumerate(devices) if i not in failed_set]
+    removed = [devices[i] for i in sorted(failed_set)]
+    if not survivors:
+        raise ValueError("no survivors to remesh over")
+    return _rebuild_mesh(mesh, survivors), removed
+
+
+def extended_mesh(mesh, new_devices: Sequence):
+    """The mesh with returning devices appended (rejoiners take the tail
+    positions — survivor worker indices, and with them the EF rows and the
+    owner partition prefix, stay stable)."""
+    devices = _data_devices(mesh)
+    return _rebuild_mesh(mesh, devices + list(new_devices))
+
+
+# -------------------------------------------------------- state migration
+
+def migrate_ef(ef: Any, failed: Sequence[int], *, policy: str = "fold",
+               fold_into: int = 0) -> Tuple[Any, float]:
+    """Shrink the EF residual's leading worker axis by ``failed``.
+
+    ``fold``: the lost rows are added into survivor row ``fold_into``
+    (survivor order) with one exact fp32 add per leaf — total residual mass
+    is conserved and re-enters the next step's gradients like any EF carry.
+    ``drop``: the lost rows are discarded; returns their global L2 norm
+    (root of the summed squares across all leaves, fp64 accumulate) so the
+    caller can account the abandoned gradient mass.
+
+    Host-side numpy on fetched arrays; returns ``(new_ef, dropped_norm)``.
+    """
+    if policy not in ("fold", "drop"):
+        raise ValueError(f"ef policy must be fold|drop, got {policy!r}")
+    if ef == ():
+        return (), 0.0
+    failed = sorted({int(f) for f in failed})
+    dropped_sq = 0.0
+
+    def one(a):
+        nonlocal dropped_sq
+        a = np.asarray(a)
+        if a.ndim < 1 or a.shape[0] <= max(failed):
+            raise ValueError(
+                f"EF leaf with leading axis {a.shape} cannot lose "
+                f"worker(s) {failed}")
+        lost = a[failed]
+        kept = np.delete(a, failed, axis=0)
+        if policy == "fold":
+            kept = kept.copy()
+            kept[fold_into] = kept[fold_into] + lost.sum(axis=0)
+        else:
+            dropped_sq += float(np.sum(lost.astype(np.float64) ** 2))
+        return kept
+
+    new_ef = jax.tree.map(one, ef)
+    return new_ef, float(np.sqrt(dropped_sq))
+
+
+def migrate_comp(comp: Any, failed: Sequence[int]) -> Any:
+    """Shrink the compressor state's leading worker axis: the PowerSGD
+    warm-start rows are identical across workers (psum-averaged), so the
+    dead rows are deleted with nothing to fold."""
+    if comp == ():
+        return ()
+    failed = sorted({int(f) for f in failed})
+    return jax.tree.map(
+        lambda a: np.delete(np.asarray(a), failed, axis=0), comp)
+
+
+def expand_ef(ef: Any, n_new: int = 1) -> Any:
+    """Append zero rows for rejoining workers (a fresh worker has not
+    withheld any gradient mass yet)."""
+    if ef == () or n_new <= 0:
+        return ef
+    return jax.tree.map(
+        lambda a: np.concatenate(
+            [np.asarray(a),
+             np.zeros((n_new,) + np.asarray(a).shape[1:],
+                      np.asarray(a).dtype)], axis=0), ef)
+
+
+def expand_comp(comp: Any, n_new: int = 1) -> Any:
+    """Append broadcast copies of survivor row 0 for rejoining workers —
+    the PowerSGD re-warm: every worker must iterate in the same basis, so
+    the newcomer adopts the survivors' converged factors instead of a cold
+    random restart."""
+    if comp == () or n_new <= 0:
+        return comp
+    return jax.tree.map(
+        lambda a: np.concatenate(
+            [np.asarray(a)]
+            + [np.asarray(a)[:1]] * n_new, axis=0), comp)
+
+
+def shrink_state(state, failed: Sequence[int], *, policy: str = "fold",
+                 fold_into: int = 0):
+    """Migrate a TrainState off the dead workers: fetch ef/comp to host,
+    shrink their leading axes, keep every replicated field bitwise.
+    Returns ``(new_state, dropped_ef_norm)`` — still host-side; the caller
+    places it on the new mesh (``with_mesh_sharding``)."""
+    ef = jax.device_get(state.ef) if state.ef != () else ()
+    comp = jax.device_get(state.comp) if state.comp != () else ()
+    new_ef, dropped = migrate_ef(ef, failed, policy=policy,
+                                 fold_into=fold_into)
+    new_comp = migrate_comp(comp, failed)
+    return dataclasses.replace(state, ef=new_ef, comp=new_comp), dropped
+
+
+def expand_state(state, n_new: int = 1):
+    """Extend a TrainState for ``n_new`` rejoining workers (zero EF rows,
+    broadcast-re-warmed comp rows); host-side, caller re-places."""
+    ef = jax.device_get(state.ef) if state.ef != () else ()
+    comp = jax.device_get(state.comp) if state.comp != () else ()
+    return dataclasses.replace(state, ef=expand_ef(ef, n_new),
+                               comp=expand_comp(comp, n_new))
+
+
+class TrimBatches:
+    """Iterable view trimming each batch dict to at most ``size`` rows —
+    the remeshed world divides a smaller global batch, so after W -> W-1
+    each batch is cut to ``(bs // W') * W'`` rows (short final batches pass
+    through untouched for the eval padding to handle)."""
+
+    def __init__(self, inner, size: int):
+        self.inner = inner
+        self.size = int(size)
+
+    def __iter__(self):
+        for batch in self.inner:
+            yield {k: v[:self.size] for k, v in batch.items()}
+
+    def __len__(self):
+        return len(self.inner)
+
+
+# ----------------------------------------------------------------- runtime
+
+class ElasticRuntime:
+    """The harness-facing elastic driver: owns the current mesh, converts
+    failures, performs the remesh, and keeps the ``elastic/*`` counters.
+
+    Typical harness shape::
+
+        el = ElasticRuntime(cfg, mesh, chaos=chaos, events=events)
+        while epoch < epochs:
+            try:
+                state, ... = train_epoch(step_for(el.mesh), state, ...)
+            except Exception as e:
+                failure = el.failure_from(e)
+                if failure is None:
+                    raise
+                state = el.handle_failure(state, failure)
+                continue        # retry the epoch on the W-1 mesh
+            epoch += 1
+    """
+
+    def __init__(self, cfg: ElasticConfig, mesh, *, chaos=None,
+                 gossip: Optional[PeerGossip] = None, events=None,
+                 place: Optional[Callable[[Any, Any], Any]] = None,
+                 log: Callable[[str], None] = print):
+        _data_devices(mesh)  # validates the mesh shape up front
+        self.cfg = cfg
+        self.mesh = mesh
+        self.chaos = chaos
+        self.gossip = gossip
+        self.events = events
+        # how to re-place a migrated state on a new mesh; the CNN default
+        # is the TrainState's own sharding rule, the LM harness passes its
+        # place_lm_state closure
+        self._place = place or (lambda s, m: s.with_mesh_sharding(m))
+        self._log = log
+        self._parked: List = []            # (rank, device) of removed peers
+        self.peer_failures = 0
+        self.remesh_count = 0
+        self.readmit_count = 0
+        self.dropped_ef_norm = 0.0
+        self.remesh_latency_ms = 0.0       # latest remesh's host latency
+
+    @property
+    def world(self) -> int:
+        return int(self.mesh.shape[DATA_AXIS])
+
+    # -- detection -------------------------------------------------------
+    def poll(self, step: Optional[int] = None) -> None:
+        """Write our own gossip heartbeat, then sweep the peers'; raises
+        :class:`PeerFailed` on newly-dead peers."""
+        if self.gossip is not None:
+            self.gossip.beat(0 if step is None else step)
+            self.gossip.raise_if_dead(step)
+
+    def bounded_get(self, x, *, step: Optional[int] = None,
+                    what: str = "step metrics fetch"):
+        """``jax.device_get`` with the peer-timeout deadline."""
+        return fetch_with_timeout(lambda: jax.device_get(x),
+                                  self.cfg.peer_timeout_s, step=step,
+                                  what=what)
+
+    def failure_from(self, exc: BaseException) -> Optional[PeerFailed]:
+        """Translate an exception into the coordinated failure it signals,
+        or None for faults that are not elastic's to handle.
+
+        * :class:`PeerFailed` passes through; an empty culprit list (a
+          fetch timeout) is filled in from the gossip's dead set.
+        * A ``mid_collective`` :class:`~tpu_compressed_dp.utils.chaos.ChaosCrash`
+          becomes the simulated death of ``chaos.worker`` — the same
+          handler path real survivors reach through gossip/timeouts.
+        """
+        from tpu_compressed_dp.utils.chaos import ChaosCrash
+
+        if isinstance(exc, PeerFailed):
+            if not exc.failed and self.gossip is not None:
+                dead = self.gossip.dead or tuple(self.gossip.check())
+                if dead:
+                    return PeerFailed(dead, step=exc.step,
+                                      reason=f"{exc.reason}; gossip names "
+                                             f"{list(dead)}")
+            return exc
+        if (isinstance(exc, ChaosCrash)
+                and getattr(exc, "mode", "step") == "mid_collective"):
+            return PeerFailed((getattr(exc, "worker", 0),),
+                              step=getattr(exc, "step", None),
+                              reason="chaos mid-collective kill")
+        return None
+
+    # -- remesh ----------------------------------------------------------
+    def handle_failure(self, state, failure: PeerFailed, *,
+                       fold_into: int = 0):
+        """Coordinated abort + remesh: shrink the mesh by the dead workers,
+        migrate EF/comp per the configured policy, re-place the state, and
+        account the event.  Returns the state ON the new mesh; the caller
+        must rebuild its jitted steps against :attr:`mesh` (which is how
+        the sharded transport's owner partition gets recomputed)."""
+        if not failure.failed:
+            raise failure
+        new_world = self.world - len(set(failure.failed))
+        if new_world < self.cfg.min_world:
+            raise PeerFailed(
+                failure.failed, step=failure.step,
+                reason=(f"{failure.reason}; surviving world {new_world} "
+                        f"below min_world {self.cfg.min_world} — "
+                        "not remeshing"))
+        t0 = time.monotonic()
+        new_mesh, removed = surviving_mesh(self.mesh, failure.failed)
+        state, dropped = shrink_state(state, failure.failed,
+                                      policy=self.cfg.ef_policy,
+                                      fold_into=fold_into)
+        state = self._place(state, new_mesh)
+        self._parked.extend(zip(sorted(set(failure.failed)), removed))
+        self.mesh = new_mesh
+        if self.gossip is not None:
+            self.gossip.note_dead(failure.failed, failure.reason)
+        self.peer_failures += len(set(failure.failed))
+        self.remesh_count += 1
+        self.dropped_ef_norm += dropped
+        self.remesh_latency_ms = (time.monotonic() - t0) * 1e3
+        self._log(f"elastic: remeshed {new_world + len(set(failure.failed))}"
+                  f" -> {new_world} workers after {failure.reason} "
+                  f"(ef={self.cfg.ef_policy}"
+                  + (f", dropped ‖ef‖={dropped:.3e}" if dropped else "")
+                  + f", {self.remesh_latency_ms:.0f} ms)")
+        if self.events is not None:
+            self.events.emit(
+                "remesh", step=failure.step, failed=list(failure.failed),
+                world=new_world, ef_policy=self.cfg.ef_policy,
+                dropped_ef_norm=float(dropped),
+                latency_ms=self.remesh_latency_ms)
+        return state
+
+    # -- re-admission ----------------------------------------------------
+    def readmit(self, state, n: Optional[int] = None):
+        """Scale back up at a remesh barrier: append up to ``n`` parked
+        devices (all, by default) back onto the mesh tail, zero their EF
+        rows, broadcast-re-warm their comp rows, and re-place the live
+        state (the "live checkpoint" — in-process survivors already hold
+        the replicated fields the rejoiner needs)."""
+        n = len(self._parked) if n is None else min(int(n), len(self._parked))
+        if n <= 0:
+            return state
+        back, self._parked = self._parked[:n], self._parked[n:]
+        ranks = [r for r, _ in back]
+        new_mesh = extended_mesh(self.mesh, [d for _, d in back])
+        state = self._place(expand_state(state, n_new=n), new_mesh)
+        self.mesh = new_mesh
+        self.readmit_count += n
+        if self.gossip is not None:
+            for r in ranks:
+                self.gossip.readmit(r)
+        self._log(f"elastic: readmitted {n} worker(s) {ranks} -> "
+                  f"world {self.world}")
+        if self.events is not None:
+            self.events.emit("readmit", ranks=ranks, world=self.world)
+        return state
+
+    @property
+    def parked(self) -> Tuple[int, ...]:
+        """Ranks currently removed from the mesh (readmission pool)."""
+        return tuple(r for r, _ in self._parked)
+
+    # -- accounting ------------------------------------------------------
+    def metrics(self) -> Dict[str, float]:
+        """The declared ``elastic/*`` keys (obs/registry.py) for the
+        harness exporters (Prometheus textfile, heartbeat payload)."""
+        return {
+            "elastic/peer_failures": float(self.peer_failures),
+            "elastic/remesh_count": float(self.remesh_count),
+            "elastic/dropped_ef_norm": float(self.dropped_ef_norm),
+            "elastic/remesh_latency_ms": float(self.remesh_latency_ms),
+        }

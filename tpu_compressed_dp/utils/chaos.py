@@ -22,6 +22,16 @@ comma-separated ``key=value`` tokens (a bare ``nan``/``inf`` sets ``kind``):
     --chaos "nan,target=grads,steps=3+7,worker=1"
     --chaos "inf,target=loss,every=50"
     --chaos "crash=120"                  # host crash only, no in-graph fault
+    --chaos "crash=mid_collective,crash_at_step=12,worker=3"
+    --chaos "peer_timeout=0.5"           # elastic: tighten gossip staleness
+
+``crash=mid_collective`` arms the host crash in the **collective phase**:
+the injector fires *after* the step has been dispatched (its collectives
+are genuinely in flight under async dispatch) instead of before — the
+deterministic stand-in for a worker dying inside an allreduce, consumed by
+the elastic runtime (:mod:`tpu_compressed_dp.train.elastic`) as a simulated
+peer failure of ``worker``.  Like every other fault here it is keyed off
+the step counter, so a restored replay reproduces it exactly.
 
 ``tools/chaos_drill.py`` runs the full injection matrix and asserts the
 guard's invariants.
@@ -61,6 +71,14 @@ class ChaosConfig:
                     (data,) or (data, seq) — see ``guard.worker_index``)
     crash_at_step:  host-side: raise :class:`ChaosCrash` before dispatching
                     this global step (-1 = off); fires once per process
+    crash_mode:     'step' (raise before dispatch — the classic dead-process
+                    crash) | 'mid_collective' (raise after dispatch, while
+                    the step's collectives are in flight; the elastic
+                    runtime interprets it as ``worker`` dying mid-allreduce)
+    peer_timeout:   elastic failure-detection budget in seconds: a peer
+                    heartbeat older than this counts as dead, and a blocked
+                    device fetch longer than this raises PeerFailed
+                    (0 = use the runtime default)
     """
 
     kind: str = "nan"
@@ -69,6 +87,8 @@ class ChaosConfig:
     every: int = 0
     worker: int = 0
     crash_at_step: int = -1
+    crash_mode: str = "step"
+    peer_timeout: float = 0.0
 
     def __post_init__(self):
         if self.kind not in ("nan", "inf"):
@@ -78,6 +98,11 @@ class ChaosConfig:
                 f"chaos target must be grads|loss, got {self.target!r}")
         if self.every < 0 or self.worker < 0:
             raise ValueError("chaos every/worker must be >= 0")
+        if self.crash_mode not in ("step", "mid_collective"):
+            raise ValueError("chaos crash_mode must be step|mid_collective, "
+                             f"got {self.crash_mode!r}")
+        if self.peer_timeout < 0:
+            raise ValueError("chaos peer_timeout must be >= 0")
 
     @property
     def injects_in_graph(self) -> bool:
@@ -104,13 +129,42 @@ class ChaosConfig:
                 kw["steps"] = tuple(int(s) for s in v.split("+") if s)
             elif k in ("every", "worker"):
                 kw[k] = int(v)
+            elif k == "crash" and v == "mid_collective":
+                # mode selector rides the crash key; the step itself comes
+                # from a separate crash_at_step=N token
+                kw["crash_mode"] = "mid_collective"
             elif k in ("crash", "crash_at_step"):
                 kw["crash_at_step"] = int(v)
+            elif k == "crash_mode":
+                kw["crash_mode"] = v
+            elif k == "peer_timeout":
+                kw["peer_timeout"] = float(v)
             else:
                 raise ValueError(
                     f"unknown --chaos key {k!r} (kind|target|steps|every|"
-                    "worker|crash)")
+                    "worker|crash|crash_mode|peer_timeout)")
         return cls(**kw)
+
+    def to_spec(self) -> str:
+        """The canonical ``--chaos`` string: ``parse(c.to_spec()) == c`` for
+        every config (the round-trip the elastic drill and replay tooling
+        rely on to re-arm an identical scenario after a relaunch)."""
+        toks = [self.kind]
+        if self.target != "grads":
+            toks.append(f"target={self.target}")
+        if self.steps:
+            toks.append("steps=" + "+".join(str(s) for s in self.steps))
+        if self.every:
+            toks.append(f"every={self.every}")
+        if self.worker:
+            toks.append(f"worker={self.worker}")
+        if self.crash_at_step >= 0:
+            toks.append(f"crash_at_step={self.crash_at_step}")
+        if self.crash_mode != "step":
+            toks.append(f"crash={self.crash_mode}")
+        if self.peer_timeout:
+            toks.append(f"peer_timeout={self.peer_timeout:g}")
+        return ",".join(toks)
 
 
 def fires_at(chaos: ChaosConfig, step: Array) -> Array:
@@ -144,25 +198,43 @@ class CrashInjector:
 
     >>> crash = CrashInjector(chaos.crash_at_step)
     >>> crash.check(global_step)   # raises ChaosCrash at/after the step
+
+    ``mode='mid_collective'`` defers the raise to the post-dispatch check:
+    the loop calls ``check(step)`` before dispatch (phase ``'step'``, a
+    no-op for this mode) and ``check(step, phase='mid_collective')`` right
+    after, when the step's collectives are in flight.  The raised
+    :class:`ChaosCrash` carries ``step``/``mode``/``worker`` so the elastic
+    runtime can translate it into the simulated peer failure.
     """
 
-    def __init__(self, crash_at_step: int):
+    def __init__(self, crash_at_step: int, mode: str = "step",
+                 worker: int = 0):
         self.crash_at_step = int(crash_at_step)
+        self.mode = mode
+        self.worker = int(worker)
         self.fired = False
 
-    def check(self, step: int) -> None:
+    def check(self, step: int, phase: str = "step") -> None:
         # >= not ==: epoch-granular callers (the CNN harnesses check once
         # per batch with the attempted-step counter) must not miss the mark
         # when a skip/resume lands the counter past it
-        if (not self.fired and self.crash_at_step >= 0
+        if (not self.fired and phase == self.mode
+                and self.crash_at_step >= 0
                 and int(step) >= self.crash_at_step):
             self.fired = True
-            raise ChaosCrash(
-                f"chaos: injected host crash at step {int(step)}")
+            err = ChaosCrash(
+                f"chaos: injected host crash at step {int(step)}"
+                + (" (mid-collective)" if self.mode == "mid_collective"
+                   else ""))
+            err.step = int(step)
+            err.mode = self.mode
+            err.worker = self.worker
+            raise err
 
 
 def maybe_crash_injector(chaos: Optional[ChaosConfig]) -> Optional[CrashInjector]:
     """Convenience for the harnesses: an armed injector, or None."""
     if chaos is None or chaos.crash_at_step < 0:
         return None
-    return CrashInjector(chaos.crash_at_step)
+    return CrashInjector(chaos.crash_at_step, mode=chaos.crash_mode,
+                         worker=chaos.worker)

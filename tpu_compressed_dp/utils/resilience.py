@@ -34,13 +34,24 @@ class Heartbeat:
     >>> hb = Heartbeat(path, interval_s=10)
     >>> hb.update(step=123)   # cheap; call from the train loop
     >>> hb.stop()
+
+    Every record carries an ``incarnation`` — monotonically increasing
+    across process restarts, seeded from ``TCDP_RESTART_COUNT`` (exported
+    by ``tools/watchdog.py --relaunch``).  A restarted worker's first
+    heartbeat therefore carries a HIGHER incarnation than any file its
+    previous life left behind, so elastic peers can tell "this rank came
+    back" from "this is the stale file of a dead prior life".
     """
 
     def __init__(self, path: str, interval_s: float = 10.0,
-                 payload: Optional[Dict[str, Any]] = None):
+                 payload: Optional[Dict[str, Any]] = None,
+                 incarnation: Optional[int] = None):
         self.path = path
         self.interval_s = interval_s
         self.payload = dict(payload or {})
+        if incarnation is None:
+            incarnation = int(os.environ.get("TCDP_RESTART_COUNT", "0") or 0)
+        self.incarnation = int(incarnation)
         self._step = 0
         # update() runs on the train loop thread while _write() iterates the
         # payload on the writer thread: unsynchronised, json.dump raises
@@ -65,8 +76,12 @@ class Heartbeat:
 
     def _write(self) -> None:
         with self._lock:
-            rec = {"ts": time.time(), "step": self._step, **self.payload}
-        tmp = self.path + ".tmp"
+            rec = {"ts": time.time(), "step": self._step,
+                   "incarnation": self.incarnation, **self.payload}
+        # pid-unique tmp name: two lives of a relaunched worker racing on
+        # the same heartbeat path must not interleave writes into one tmp
+        # file (the os.replace itself is atomic either way)
+        tmp = f"{self.path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump(rec, f)
         os.replace(tmp, self.path)  # atomic: readers never see partial JSON
@@ -85,17 +100,33 @@ class Heartbeat:
 
 
 def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
+    """Parse a heartbeat file; ``None`` on ANY unreadable content.
+
+    The writer's atomic-replace means a well-behaved filesystem never
+    shows a torn record, but elastic gossip reads peers' files over shared
+    storage where torn/truncated reads DO happen (NFS close-to-open,
+    object-store gateways) — so every decode failure (truncated JSON,
+    garbage bytes, a non-object payload) degrades to "no heartbeat", never
+    an exception out of the failure detector."""
     try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, json.JSONDecodeError):
+        with open(path, "rb") as f:
+            rec = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        # ValueError covers json.JSONDecodeError and UnicodeDecodeError
         return None
+    return rec if isinstance(rec, dict) else None
 
 
 def is_stale(path: str, max_age_s: float) -> bool:
-    """True when the heartbeat is missing or older than ``max_age_s``."""
+    """True when the heartbeat is missing, unreadable, lacks a numeric
+    ``ts``, or is older than ``max_age_s``."""
     hb = read_heartbeat(path)
-    return hb is None or (time.time() - hb["ts"]) > max_age_s
+    if hb is None:
+        return True
+    ts = hb.get("ts")
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+        return True
+    return (time.time() - ts) > max_age_s
 
 
 def check_heartbeat(path: str, *, max_age_s: float = 60.0,
