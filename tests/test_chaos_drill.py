@@ -31,6 +31,43 @@ def test_quick_drill(mesh8):
     assert results["elastic_remesh"]["dropped_ef_norm"] == 0.0  # fold policy
 
 
+@pytest.mark.quick
+def test_every_quick_row_registered_and_collectible(capsys):
+    """CI discovery contract: every quick row expands to a concrete drill
+    (a ``drill_*`` function exists for it), and ``--list`` prints the full
+    quick/slow row matrix — so a row can neither silently vanish from the
+    tier-1 gate nor run unlisted."""
+    # matrix groups expand inline; aliased rows re-parameterise another drill
+    matrix = ("skip_matrix", "elastic_matrix")
+    alias = {"ef_identity_sharded": "ef_identity"}
+
+    def resolves(name):
+        return callable(
+            getattr(chaos_drill, f"drill_{alias.get(name, name)}", None))
+
+    quick_rows = chaos_drill.expand_rows(chaos_drill.QUICK)
+    assert quick_rows, "quick tier is empty"
+    for name in chaos_drill.QUICK:
+        assert name in chaos_drill.FULL, f"{name} missing from FULL"
+        if name not in matrix:
+            assert resolves(name), f"quick row {name} has no drill function"
+    rc = chaos_drill.main(["--list"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "quick:" in out and "slow:" in out
+    listed = [ln.strip() for ln in out.splitlines()
+              if ln.startswith("  ")]
+    for row in quick_rows:
+        assert row in listed, f"quick row {row} missing from --list"
+    for row in chaos_drill.expand_rows(
+            [n for n in chaos_drill.FULL if n not in chaos_drill.QUICK]):
+        assert row in listed, f"slow row {row} missing from --list"
+    # every FULL name resolves too (the slow tier is equally collectible)
+    for name in chaos_drill.FULL:
+        if name not in matrix:
+            assert resolves(name), name
+
+
 @pytest.mark.slow
 def test_full_drill_matrix(mesh8):
     results = chaos_drill.run_drills(
@@ -51,6 +88,8 @@ def test_full_drill_matrix(mesh8):
                 elif kill_step > 0:
                     assert cell["dropped_ef_norm"] > 0.0
     assert results["elastic[sharded-wire]"]["world"] == 7
+    # cascade: during_remesh second death -> one committed remesh at W-2
+    assert results["elastic_cascade"] == {"world": 6, "cascades": 1}
 
 
 @pytest.mark.slow

@@ -36,6 +36,12 @@ class TestChaosSpec:
                               "peer_timeout=0.5")
         assert c.peer_timeout == 0.5
 
+    def test_parse_during_remesh(self):
+        c = ChaosConfig.parse("crash=during_remesh,crash_at_step=12,worker=3")
+        assert c.crash_mode == "during_remesh"
+        assert c.crash_at_step == 12 and c.worker == 3
+        assert not c.injects_in_graph
+
     def test_to_spec_round_trips(self):
         for spec in (
             "crash=mid_collective,crash_at_step=12,worker=3,peer_timeout=0.5",
@@ -45,6 +51,37 @@ class TestChaosSpec:
         ):
             c = ChaosConfig.parse(spec)
             assert ChaosConfig.parse(c.to_spec()) == c, spec
+
+    def test_every_documented_spec_rearms_identically(self):
+        """The utils/chaos.py docstring's CLI examples (plus the
+        during_remesh mode), round-tripped through ``to_spec`` — the
+        string a relaunched process re-arms from.  The config AND the
+        armed CrashInjector must come back identical, or a watchdog
+        relaunch would replay a different fault scenario than the one
+        that killed the previous life."""
+        from tpu_compressed_dp.utils.chaos import maybe_crash_injector
+
+        documented = (
+            "nan,target=grads,steps=3+7,worker=1",
+            "inf,target=loss,every=50",
+            "crash=120",
+            "crash=mid_collective,crash_at_step=12,worker=3",
+            "crash=during_remesh,crash_at_step=12,worker=3",
+            "peer_timeout=0.5",
+            "nan",
+            "inf",
+        )
+        for spec in documented:
+            c = ChaosConfig.parse(spec)
+            c2 = ChaosConfig.parse(c.to_spec())
+            assert c2 == c, spec
+            assert c2.to_spec() == c.to_spec(), spec
+            inj, inj2 = maybe_crash_injector(c), maybe_crash_injector(c2)
+            assert (inj is None) == (inj2 is None), spec
+            if inj is not None:
+                assert (inj.crash_at_step, inj.mode, inj.worker) == \
+                    (inj2.crash_at_step, inj2.mode, inj2.worker), spec
+                assert not inj2.fired  # re-armed, not already spent
 
     def test_bad_crash_mode_rejected(self):
         with pytest.raises(ValueError, match="crash_mode"):
@@ -169,6 +206,45 @@ class TestPeerGossip:
         g.readmit(1)
         assert g.dead == () and g.check() == {}
 
+    def test_ntp_step_cannot_mass_declare_peers_dead(self, tmp_path):
+        """Staleness runs on the LOCAL monotonic clock and record-change
+        detection; the writers' wall-clock ``ts`` is never compared to
+        local time.  A cluster-wide NTP step (every peer's ts jumps
+        backward) therefore keeps everyone alive as long as they keep
+        writing — and real silence still detects on schedule."""
+        clock = {"t": 100.0}
+        g = self._gossip(tmp_path, clock, world=3)          # timeout 5s
+        # wildly skewed writer clocks on admission: irrelevant
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 0, ts=999999.0)
+        elastic.write_peer_heartbeat(str(tmp_path), 2, 0, ts=-500.0)
+        assert g.check() == {}
+        # the NTP step: both writers' ts jump far BACKWARD, records keep
+        # changing -> alive
+        clock["t"] += 4.0
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 1, ts=42.0)
+        elastic.write_peer_heartbeat(str(tmp_path), 2, 1, ts=-501.0)
+        assert g.check() == {}, "NTP step mass-declared live peers dead"
+        clock["t"] += 4.0
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 2, ts=41.0)
+        elastic.write_peer_heartbeat(str(tmp_path), 2, 2, ts=-502.0)
+        assert g.check() == {}
+        # genuine silence: both die within one local timeout window
+        clock["t"] += 6.0
+        assert set(g.check()) == {1, 2}
+
+    def test_unchanged_record_goes_stale_on_local_clock(self, tmp_path):
+        """A record that stops CHANGING is silence, even if its wall ts
+        looks perpetually 'fresh' relative to a skewed local clock."""
+        clock = {"t": 100.0}
+        g = self._gossip(tmp_path, clock, world=2)
+        # writer's wall clock is far in our future; file never changes
+        elastic.write_peer_heartbeat(str(tmp_path), 1, 0, ts=1e9)
+        assert g.check() == {}
+        clock["t"] += 4.0
+        assert g.check() == {}          # within the timeout window
+        clock["t"] += 2.0               # 6s of local silence
+        assert list(g.check()) == [1]
+
     def test_dead_peer_rejoins_on_fresh_higher_incarnation(self, tmp_path):
         clock = {"t": 100.0}
         g = self._gossip(tmp_path, clock, world=2)
@@ -213,6 +289,52 @@ class TestFetchWithTimeout:
 
         with pytest.raises(KeyError, match="inner"):
             elastic.fetch_with_timeout(boom, 5.0)
+
+    def test_timeout_hammer_leaks_no_threads(self):
+        """Repeated timeouts must not accumulate runner threads: each
+        abandoned runner is tracked while its fetch is still blocked and
+        reaped the moment it drains."""
+        baseline = elastic.abandoned_fetch_count()
+        release = threading.Event()
+        n = 8
+        for i in range(n):
+            with pytest.raises(elastic.PeerFailed):
+                elastic.fetch_with_timeout(lambda: release.wait(30.0), 0.02,
+                                           what=f"hammer {i}")
+        assert elastic.abandoned_fetch_count() <= baseline + n
+        assert elastic.abandoned_fetch_count() >= 1  # tracked, not lost
+        release.set()                   # the blocked fetches all drain now
+        deadline = time.time() + 10.0
+        while (elastic.abandoned_fetch_count() > baseline
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert elastic.abandoned_fetch_count() <= baseline, \
+            "abandoned fetch threads leaked after their fetches drained"
+
+    def test_timed_out_fetch_discards_late_buffer(self):
+        """A fetch that completes AFTER its deadline must drop the fetched
+        buffer (the discard flag), not pin a dead world's arrays in a
+        result box nobody reads."""
+        import gc
+        import weakref
+
+        release = threading.Event()
+        refs = []
+
+        def slow_fetch():
+            buf = np.ones((256,), np.float32)
+            refs.append(weakref.ref(buf))
+            release.wait(30.0)
+            return buf
+
+        with pytest.raises(elastic.PeerFailed):
+            elastic.fetch_with_timeout(slow_fetch, 0.02, what="late buffer")
+        release.set()
+        deadline = time.time() + 10.0
+        while refs[0]() is not None and time.time() < deadline:
+            gc.collect()
+            time.sleep(0.01)
+        assert refs[0]() is None, "late fetch result pinned after discard"
 
 
 # ---------------------------------------------------------- state migration
@@ -292,6 +414,72 @@ class TestMigration:
             assert np.array_equal(out[k][4], comp[k][0])
 
 
+import dataclasses as _dc
+
+
+@_dc.dataclass
+class _FakeState:
+    """Bare dataclass standing in for TrainState in migration/runtime
+    tests — the shrink/expand helpers only touch ``ef``/``comp`` and go
+    through ``dataclasses.replace``."""
+
+    ef: object = ()
+    comp: object = ()
+
+
+class TestRowGroupMigration:
+    """dp x sp (LM) row-group arithmetic: the EF leading dim is the SYNC
+    world (dp*sp), data-major — data row d owns leading rows
+    [d*m, (d+1)*m).  Losing a data row must take its whole row GROUP."""
+
+    def test_rows_per_data_row(self):
+        ef = {"a": np.zeros((8, 4), np.float32)}
+        assert elastic._rows_per_data_row(ef, 4) == 2      # dp=4, sp=2
+        assert elastic._rows_per_data_row(ef, 8) == 1      # pure dp
+        assert elastic._rows_per_data_row((), 4) == 1
+        with pytest.raises(ValueError):
+            elastic._rows_per_data_row(ef, 3)              # 8 % 3 != 0
+
+    def test_shrink_folds_the_whole_row_group(self):
+        rng = np.random.RandomState(0)
+        ef = {"a": rng.randn(8, 4).astype(np.float32)}
+        state = _FakeState(ef=ef, comp={"q": rng.randn(8, 3).astype(np.float32)})
+        # dp=4: data row 1 owns leading rows 2 and 3
+        out, dropped = elastic.shrink_state(state, [1], policy="fold",
+                                            data_world=4)
+        assert dropped == 0.0
+        expect = np.delete(ef["a"], [2, 3], axis=0)
+        expect[0] = expect[0] + ef["a"][[2, 3]].sum(axis=0)
+        assert np.array_equal(out.ef["a"], expect)
+        assert out.comp["q"].shape[0] == 6
+        # total EF mass conserved through the fold (the fold/drop invariant:
+        # what was withheld stays accounted — folded back or norm-counted)
+        assert np.allclose(out.ef["a"].sum(axis=0), ef["a"].sum(axis=0),
+                           atol=1e-5)
+
+    def test_shrink_drop_accounts_the_row_group_norm(self):
+        rng = np.random.RandomState(1)
+        ef = {"a": rng.randn(8, 4).astype(np.float32)}
+        state = _FakeState(ef=ef)
+        out, dropped = elastic.shrink_state(state, [3], policy="drop",
+                                            data_world=4)
+        lost = ef["a"][[6, 7]]
+        assert dropped == pytest.approx(
+            float(np.sqrt(np.sum(lost.astype(np.float64) ** 2))), abs=0)
+        assert np.array_equal(out.ef["a"], ef["a"][:6])
+
+    def test_expand_appends_row_groups(self):
+        rng = np.random.RandomState(2)
+        state = _FakeState(ef={"a": rng.randn(6, 4).astype(np.float32)},
+                           comp={"q": rng.randn(6, 3).astype(np.float32)})
+        # current dp=3 (m=2); one rejoining data row appends 2 leading rows
+        out = elastic.expand_state(state, n_new=1, data_world=3)
+        assert out.ef["a"].shape[0] == 8
+        assert not np.any(out.ef["a"][6:])                 # zero EF rows
+        assert np.array_equal(out.comp["q"][6], out.comp["q"][0])
+        assert np.array_equal(out.comp["q"][7], out.comp["q"][0])
+
+
 class TestTrimBatches:
     def test_trims_rows_and_keeps_len(self):
         inner = [{"x": np.arange(8), "y": np.arange(8) * 2} for _ in range(3)]
@@ -322,13 +510,41 @@ class TestMeshSurgery:
         devices = list(mesh8.devices.reshape(-1))
         assert list(back.devices.reshape(-1)) == devices[1:] + [devices[0]]
 
-    def test_rejects_model_parallel_mesh(self):
+    def test_model_parallel_mesh_loses_full_data_row(self):
+        """Losing data row i of a dp x tp mesh removes ALL of that row's
+        model-axis devices (the model shards are replicated across data
+        rows, so the survivors keep a complete copy)."""
         from jax.sharding import Mesh
 
         devs = np.array(jax.devices()[:8]).reshape(4, 2)
         mesh = Mesh(devs, ("data", "tensor"))
-        with pytest.raises(ValueError, match="model axes"):
-            elastic.surviving_mesh(mesh, [1])
+        new_mesh, removed = elastic.surviving_mesh(mesh, [1])
+        assert tuple(new_mesh.axis_names) == ("data", "tensor")
+        assert new_mesh.shape["data"] == 3
+        assert new_mesh.shape["tensor"] == 2
+        assert removed == [list(devs[1])]
+        assert new_mesh.devices.tolist() == np.delete(devs, 1, 0).tolist()
+        # the parked row readmits at the mesh tail, model axis intact
+        back = elastic.extended_mesh(new_mesh, removed)
+        assert back.shape["data"] == 4 and back.shape["tensor"] == 2
+        assert list(back.devices[-1]) == list(devs[1])
+
+    def test_non_leading_data_axis_round_trips(self):
+        """Axis order is preserved when the data axis is not axis 0 (the
+        LM harness's dp x sp layouts put it wherever the step factory
+        wants it)."""
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("tensor", "data"))
+        new_mesh, removed = elastic.surviving_mesh(mesh, [0])
+        assert tuple(new_mesh.axis_names) == ("tensor", "data")
+        assert new_mesh.shape["tensor"] == 2 and new_mesh.shape["data"] == 3
+        assert removed == [list(devs[:, 0])]
+        assert new_mesh.devices.tolist() == devs[:, 1:].tolist()
+        back = elastic.extended_mesh(new_mesh, removed)
+        assert back.shape["data"] == 4
+        assert back.devices[:, -1].tolist() == devs[:, 0].tolist()
 
     def test_unit_model_axes_accepted(self):
         from jax.sharding import Mesh
@@ -400,3 +616,109 @@ class TestElasticRuntime:
         el = self._runtime(mesh8)
         for key in el.metrics():
             assert registry.is_declared(key), key
+
+    def test_remesh_ms_accumulates_downtime(self, mesh8):
+        rng = np.random.RandomState(0)
+        el = elastic.ElasticRuntime(elastic.ElasticConfig(), mesh8,
+                                    place=lambda s, m: s, log=lambda s: None)
+        state = _FakeState(ef={"a": rng.randn(8, 4).astype(np.float32)})
+        assert el.metrics()["elastic/remesh_ms"] == 0.0
+        state = el.handle_failure(state, elastic.PeerFailed((2,), step=1))
+        after_shrink = el.remesh_ms
+        assert after_shrink >= el.remesh_latency_ms > 0.0
+        el.readmit(state)
+        assert el.remesh_ms > after_shrink     # readmission downtime counts
+        assert el.metrics()["elastic/remesh_ms"] == el.remesh_ms
+
+    def test_handle_failure_on_dp_tp_mesh(self):
+        """The tentpole's model-axis remesh: a dp x tp virtual mesh loses
+        a data row and RE-SHARDS instead of refusing; the EF fold/drop
+        invariant (withheld mass folded back or norm-accounted) holds with
+        one EF row per data row (m = lead // dp = 1)."""
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, ("data", "tensor"))
+        rng = np.random.RandomState(3)
+        for policy in ("fold", "drop"):
+            el = elastic.ElasticRuntime(
+                elastic.ElasticConfig(ef_policy=policy), mesh,
+                place=lambda s, m: s, log=lambda s: None)
+            ef = {"a": rng.randn(4, 6).astype(np.float32)}
+            state = _FakeState(ef=ef)
+            out = el.handle_failure(state, elastic.PeerFailed((1,), step=2))
+            assert el.world == 3 and el.mesh.shape["tensor"] == 2
+            assert el.parked == (1,)
+            if policy == "fold":
+                expect = np.delete(ef["a"], 1, axis=0)
+                expect[0] = expect[0] + ef["a"][1]
+                assert np.array_equal(out.ef["a"], expect)
+                assert el.dropped_ef_norm == 0.0
+            else:
+                assert np.array_equal(out.ef["a"],
+                                      np.delete(ef["a"], 1, axis=0))
+                assert el.dropped_ef_norm == pytest.approx(float(
+                    np.sqrt(np.sum(ef["a"][1].astype(np.float64) ** 2))),
+                    abs=0)
+            # readmit restores the full dp x tp grid at the tail
+            back = el.readmit(out)
+            assert el.world == 4 and el.mesh.shape["tensor"] == 2
+            assert back.ef["a"].shape[0] == 4
+            assert not np.any(back.ef["a"][-1])
+
+    def test_handle_failure_on_dp_sp_mesh_row_groups(self):
+        """dp x sp (the LM layout): the EF lead is dp*sp and losing data
+        row d takes its whole row group [d*m, (d+1)*m)."""
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, ("data", "seq"))
+        rng = np.random.RandomState(4)
+        el = elastic.ElasticRuntime(
+            elastic.ElasticConfig(ef_policy="fold"), mesh,
+            place=lambda s, m: s, ef_axes=("data", "seq"),
+            log=lambda s: None)
+        ef = {"a": rng.randn(8, 5).astype(np.float32)}     # dp*sp = 8 rows
+        state = _FakeState(ef=ef)
+        out = el.handle_failure(state, elastic.PeerFailed((2,), step=1))
+        assert el.world == 3
+        expect = np.delete(ef["a"], [4, 5], axis=0)        # row group of d=2
+        expect[0] = expect[0] + ef["a"][[4, 5]].sum(axis=0)
+        assert np.array_equal(out.ef["a"], expect)
+        assert np.allclose(out.ef["a"].sum(axis=0), ef["a"].sum(axis=0),
+                           atol=1e-5)                      # mass conserved
+        back = el.readmit(out)
+        assert el.world == 4 and back.ef["a"].shape[0] == 8
+        assert not np.any(back.ef["a"][6:])
+
+    def test_cascade_unions_dead_set(self, mesh8):
+        """``crash=during_remesh``: the injector fires while the runtime
+        is inside ``handle_failure`` — the dead set is unioned and the
+        shrink restarts from the uncommitted mesh (one committed remesh,
+        both ranks parked)."""
+        rng = np.random.RandomState(5)
+        crash = CrashInjector(0, mode="during_remesh", worker=5)
+        el = elastic.ElasticRuntime(
+            elastic.ElasticConfig(), mesh8, crash=crash,
+            place=lambda s, m: s, log=lambda s: None)
+        ef = {"a": rng.randn(8, 4).astype(np.float32)}
+        out = el.handle_failure(_FakeState(ef=ef),
+                                elastic.PeerFailed((3,), step=0))
+        assert el.world == 6 and el.parked == (3, 5)
+        assert el.cascade_count == 1 and el.remesh_count == 1
+        assert el.peer_failures == 2
+        expect = np.delete(ef["a"], [3, 5], axis=0)
+        expect[0] = expect[0] + ef["a"][[3, 5]].sum(axis=0)
+        assert np.array_equal(out.ef["a"], expect)
+
+    def test_cascade_below_min_world_raises_cleanly(self, mesh8):
+        """A cascade whose union would shrink below min_world raises a
+        PeerFailed naming EVERY dead rank — nothing committed, no wedge."""
+        crash = CrashInjector(0, mode="during_remesh", worker=5)
+        el = elastic.ElasticRuntime(
+            elastic.ElasticConfig(min_world=7), mesh8, crash=crash,
+            place=lambda s, m: s, log=lambda s: None)
+        with pytest.raises(elastic.PeerFailed, match="min_world") as ei:
+            el.handle_failure(_FakeState(), elastic.PeerFailed((3,), step=0))
+        assert ei.value.failed == (3, 5)
+        assert el.world == 8 and el.remesh_count == 0

@@ -42,6 +42,11 @@ Elastic drills (the ISSUE 7 acceptance row — train/elastic.py):
   * ``elastic_readmit`` — scale back up: the parked worker rejoins at a
     barrier with a zero EF row and PowerSGD factors broadcast-re-warmed
     from survivor row 0, then trains at full W again.
+  * ``elastic_cascade`` — ``crash=during_remesh``: a second worker dies
+    while survivors are inside ``handle_failure``; the dead set is unioned
+    and the shrink restarts (one cascading remesh down to ``min_world``),
+    and a union landing below ``min_world`` raises a clean PeerFailed
+    naming every dead rank instead of wedging.
   * ``elastic_matrix`` — the kill-step x worker x EF-policy cross, plus a
     wire+sharded-transport variant (the owner partition recomputes at W-1).
 
@@ -49,6 +54,7 @@ Usage::
 
     python tools/chaos_drill.py --quick     # tier-1 smoke subset (~4 drills)
     python tools/chaos_drill.py             # full matrix (slow)
+    python tools/chaos_drill.py --list      # quick/slow drill-row matrix
 
 Exit code 0 = every invariant held.
 """
@@ -469,7 +475,9 @@ def drill_elastic_remesh(mesh, *, kill_step=2, worker=3, policy="fold",
     assert el.remesh_count == 1 and el.peer_failures == 1
     assert set(el.metrics()) == {
         "elastic/peer_failures", "elastic/remesh_count",
-        "elastic/dropped_ef_norm", "elastic/remesh_latency_ms"}
+        "elastic/dropped_ef_norm", "elastic/remesh_latency_ms",
+        "elastic/remesh_ms"}
+    assert el.metrics()["elastic/remesh_ms"] >= el.remesh_latency_ms
     for leaf in jax.tree.leaves(state.ef):
         assert np.asarray(leaf).shape[0] == W - 1
     return {"world": el.world, "dropped_ef_norm": el.dropped_ef_norm}
@@ -506,13 +514,100 @@ def drill_elastic_readmit(mesh) -> Dict:
     return {"world": el.world, "readmits": el.readmit_count}
 
 
+def drill_elastic_cascade(mesh) -> Dict:
+    """``crash=during_remesh``: a SECOND worker dies while survivors are
+    inside ``handle_failure``.  The runtime unions the dead set and
+    restarts the shrink from the uncommitted mesh — one cascading remesh
+    down to ``min_world`` — and a union that would land BELOW
+    ``min_world`` raises a clean PeerFailed naming every dead rank
+    (mesh untouched) instead of wedging or committing a stale world."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.elastic import (ElasticConfig,
+                                                 ElasticRuntime, PeerFailed)
+    from tpu_compressed_dp.utils.chaos import ChaosConfig, maybe_crash_injector
+
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True,
+                             mode="simulate", granularity="entiremodel")
+    chaos = ChaosConfig.parse(
+        "crash=during_remesh,crash_at_step=2,worker=5,peer_timeout=30")
+    state, step, step_for = _tiny_setup(mesh, comp, None, None,
+                                        with_factory=True)
+    W = int(mesh.shape["data"])
+    batch = _batch(n=48)                 # 48 divides W=8 and W-2=6
+
+    # arm 1: the union (8 - 2 = 6) lands exactly ON min_world => one
+    # cascading shrink commits
+    el = ElasticRuntime(ElasticConfig(ef_policy="fold", min_world=W - 2),
+                        mesh, chaos=chaos,
+                        crash=maybe_crash_injector(chaos), log=lambda s: None)
+    state, _ = step(state, batch)
+    pre = _snap(state)
+    old_ef = jax.device_get(state.ef)
+    state = el.handle_failure(state, PeerFailed((3,), step=2, reason="drill"))
+    assert el.world == W - 2 and el.parked == (3, 5), (el.world, el.parked)
+    assert el.cascade_count == 1 and el.remesh_count == 1
+    assert el.peer_failures == 2, el.peer_failures
+    post = _snap(state, ("params", "opt_state", "batch_stats"))
+    _assert_bitwise({k: pre[k] for k in post}, post,
+                    "elastic_cascade replicated state")
+    for la, lb in zip(jax.tree.leaves(old_ef),
+                      jax.tree.leaves(jax.device_get(state.ef))):
+        la, lb = np.asarray(la), np.asarray(lb)
+        expect = np.delete(la, [3, 5], axis=0)
+        # one fold of the UNION: row0 + sum(lost rows), matching migrate_ef
+        expect[0] = expect[0] + la[[3, 5]].sum(axis=0)
+        assert np.array_equal(expect, lb), "cascade EF fold not bitwise"
+    state, _ = step_for(el.mesh)(state, batch)   # survivors keep training
+    assert int(state.step) == 2
+
+    # arm 2: the union would land BELOW min_world => a clean PeerFailed
+    # naming both ranks, nothing committed
+    chaos2 = ChaosConfig.parse(
+        "crash=during_remesh,crash_at_step=2,worker=5,peer_timeout=30")
+    state2, _ = _tiny_setup(mesh, comp, None, None)
+    el2 = ElasticRuntime(ElasticConfig(ef_policy="fold", min_world=W - 1),
+                         mesh, chaos=chaos2,
+                         crash=maybe_crash_injector(chaos2),
+                         log=lambda s: None)
+    try:
+        el2.handle_failure(state2, PeerFailed((3,), step=2, reason="drill"))
+        raise AssertionError("below-min_world cascade did not raise")
+    except PeerFailed as pf:
+        assert pf.failed == (3, 5), pf
+        assert "min_world" in (pf.reason or ""), pf
+    assert el2.world == W and el2.remesh_count == 0, "stale world committed"
+    return {"world": el.world, "cascades": el.cascade_count}
+
+
 # -------------------------------------------------------------------- main
 
 QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery",
          "elastic_gossip", "elastic_remesh"]
 FULL = QUICK + ["comp_hold", "ef_identity", "poison_control",
                 "skip_matrix", "ef_identity_sharded",
-                "elastic_readmit", "elastic_matrix"]
+                "elastic_readmit", "elastic_cascade", "elastic_matrix"]
+
+
+def expand_rows(names) -> list:
+    """The concrete drill rows a name list runs — matrix groups expand to
+    their cells, everything else maps 1:1.  ``--list`` prints these and the
+    tier-1 registration test (tests/test_chaos_drill.py) keys off them."""
+    rows = []
+    for name in names:
+        if name == "skip_matrix":
+            rows += [f"skip[{kind},{target},w{worker}]"
+                     for kind in ("nan", "inf")
+                     for target in ("grads", "loss")
+                     for worker in (0, 7)]
+        elif name == "elastic_matrix":
+            rows += [f"elastic[{policy},w{worker},s{kill_step}]"
+                     for policy in ("fold", "drop")
+                     for worker in (0, 7)
+                     for kill_step in (0, 3)]
+            rows.append("elastic[sharded-wire]")
+        else:
+            rows.append(name)
+    return rows
 
 
 def run_drills(names, mesh=None) -> Dict[str, Dict]:
@@ -563,7 +658,22 @@ def main(argv=None) -> int:
                         "elastic_remesh)")
     p.add_argument("--drill", action="append", default=None,
                    help="run only the named drill(s)")
+    p.add_argument("--list", action="store_true",
+                   help="print the quick/slow drill-row matrix (matrix "
+                        "groups expanded to their cells) and exit")
     args = p.parse_args(argv)
+    if args.list:
+        # CI discovery surface: one row per concrete drill, tier-tagged.
+        # tests/test_chaos_drill.py asserts every quick row is registered
+        # here and collectible (a drill function exists for it).
+        slow_only = [n for n in FULL if n not in QUICK]
+        print("quick:")
+        for row in expand_rows(QUICK):
+            print(f"  {row}")
+        print("slow:")
+        for row in expand_rows(slow_only):
+            print(f"  {row}")
+        return 0
     names = args.drill or (QUICK if args.quick else FULL)
     run_drills(names)
     print(f"chaos drill: {len(names)} drill group(s) passed")

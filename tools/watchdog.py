@@ -32,6 +32,14 @@ child's last exit code (or 1).  The restart budget is CONSECUTIVE — any
 healthy check refills it — so a long-lived run that crashes once a day is
 not eventually abandoned.
 
+With ``--elastic_dir`` the relaunch is ELASTIC-aware: every spawn exports
+``TCDP_RESTART_COUNT`` (the child's heartbeat incarnation) plus, when the
+rendezvous directory holds a committed world epoch, the epoch and
+coordinator address (``TCDP_RENDEZVOUS_EPOCH``/``TCDP_RENDEZVOUS_ADDR``)
+— so a restarted host rejoins the RUNNING world's readmit barrier instead
+of forming a fresh one (train/rendezvous.py).  A child that parks on its
+join deadline exits nonzero; the watchdog's backoff is the retry loop.
+
 Usage::
 
     python tools/watchdog.py --check --heartbeat /path/hb.json
@@ -188,6 +196,21 @@ def run_relaunch(args, cmd: List[str]) -> int:
         # heartbeats are distinguishable from the stale file its previous
         # life left behind (utils/resilience.Heartbeat, train/elastic.py)
         env = dict(os.environ, TCDP_RESTART_COUNT=str(launches["n"]))
+        if getattr(args, "elastic_dir", None):
+            # rejoin hint: when the rendezvous directory already holds a
+            # committed world epoch, the survivors are still training —
+            # export it so the child lands in THAT world's join barrier
+            # (train/rendezvous.maybe_rejoin_from_env) instead of forming
+            # a fresh single-process world
+            from tpu_compressed_dp.train.rendezvous import (DIR_ENV,
+                                                            export_env,
+                                                            read_epoch)
+            env[DIR_ENV] = args.elastic_dir
+            rec = read_epoch(args.elastic_dir)
+            if rec is not None:
+                export_env(env, rec)
+                print(f"watchdog: rejoin hint: world epoch {rec['epoch']} "
+                      f"@ {rec.get('address')}")
         launches["n"] += 1
         print(f"watchdog: launching: {' '.join(cmd)}")
         return subprocess.Popen(cmd, env=env)
@@ -232,6 +255,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "per consecutive restart)")
     p.add_argument("--backoff_cap", type=float, default=300.0,
                    help="relaunch mode: backoff ceiling")
+    p.add_argument("--elastic_dir", type=str, default=None,
+                   help="relaunch mode: the run's shared rendezvous/gossip "
+                        "directory (harness --elastic_dir); exports the "
+                        "committed world epoch + coordinator address to "
+                        "the child so a restarted host REJOINS the running "
+                        "world instead of forming a fresh one")
     argv = list(sys.argv[1:] if argv is None else argv)
     # split at the FIRST `--`: left side is parsed STRICTLY (a misspelled
     # watchdog flag is an argparse error, never silently folded into the

@@ -33,6 +33,7 @@ from tpu_compressed_dp.data import cifar10 as data
 from tpu_compressed_dp.harness.loop import (add_robustness_args,
                                             add_telemetry_args,
                                             build_elastic, build_robustness,
+                                            elastic_distributed_init,
                                             make_event_stream, make_heartbeat,
                                             profile_trace, train_epoch)
 from tpu_compressed_dp.models import alexnet as alexnet_mod
@@ -45,7 +46,7 @@ from tpu_compressed_dp.models.common import (
 )
 from tpu_compressed_dp.parallel.dp import (CompressionConfig, init_comp_state,
                                            init_ef_state)
-from tpu_compressed_dp.parallel.mesh import distributed_init, make_data_mesh
+from tpu_compressed_dp.parallel.mesh import make_data_mesh
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.guard import init_guard_state
 from tpu_compressed_dp.train.schedules import piecewise_linear
@@ -271,7 +272,7 @@ def run(args) -> dict:
             f"--method {args.method} requires --compress layerwise|entiremodel "
             "(the reference silently trained dense here; we refuse instead)"
         )
-    distributed_init(args.coordinator, args.num_processes, args.process_id)
+    rejoin = elastic_distributed_init(args)
     mesh = make_data_mesh(args.devices)
     ndev = mesh.shape["data"]
     epochs = args.epochs if args.epochs is not None else default_epochs(args.method)
@@ -424,12 +425,15 @@ def run(args) -> dict:
         args, harness="dawn", network=args.network,
         method=args.method, compress=args.compress, mode=args.mode,
         transport=args.transport, batch_size=bs, devices=ndev, epochs=epochs)
-    if getattr(args, "elastic", False) and procs > 1:
-        raise ValueError(
-            "--elastic drives the single-process simulation (one mesh "
-            "device per worker); real multi-host abort is a process exit "
-            "+ watchdog relaunch into the remesh barrier")
-    el = build_elastic(args, mesh, chaos=chaos, events=events)
+    el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events)
+    if el is not None and rejoin is not None:
+        # watchdog-relaunched host: adopt the running world's replicated
+        # state from the re-elected coordinator's broadcast (EF rows start
+        # at zero) and retrace the steps on the post-join mesh
+        state = el.join_world(state, rejoin)
+        mesh, ndev = el.mesh, el.world
+        step_cache.clear()
+        eval_step = make_eval_step(apply_fn, mesh)
     # Per-chip forward FLOPs from XLA's cost model, once (the epoch loop
     # scales it by the measured step rate — utils/flops.py conventions:
     # train = 3x fwd, MFU vs the chip's bf16 peak, omitted off-TPU).  The
@@ -488,6 +492,18 @@ def run(args) -> dict:
                 cur_train = TrimBatches(train_batches, cur_bs)
                 cur_test = TrimBatches(test_batches, cur_bs)
                 continue
+            if el is not None:
+                # epoch-boundary readmission of watchdog-relaunched hosts
+                # parked in the rendezvous join barrier (no-op otherwise)
+                state, grew = el.rejoin_barrier(state)
+                if grew:
+                    mesh, ndev = el.mesh, el.world
+                    step_cache.clear()
+                    eval_step = make_eval_step(apply_fn, mesh)
+                    cur_bs = (bs // ndev) * ndev
+                    from tpu_compressed_dp.train.elastic import TrimBatches
+                    cur_train = TrimBatches(train_batches, cur_bs)
+                    cur_test = TrimBatches(test_batches, cur_bs)
             train_time = epoch_stats["train time"]
             examples = len(cur_train) * cur_bs
             thr = flops_mod.throughput_record(

@@ -27,7 +27,6 @@ import numpy as np
 from tpu_compressed_dp.data import lm as lm_data
 from tpu_compressed_dp.models import transformer as tf
 from tpu_compressed_dp.parallel.dp import CompressionConfig
-from tpu_compressed_dp.parallel.mesh import distributed_init
 from tpu_compressed_dp.train.lm_step import (
     init_lm_ef_state,
     make_lm_mesh,
@@ -159,7 +158,9 @@ def build_config(args) -> tf.LlamaConfig:
 def run(args) -> Dict[str, float]:
     if args.method.lower() != "none" and args.compress == "none":
         raise ValueError(f"--method {args.method} requires --compress layerwise|entiremodel")
-    distributed_init(args.coordinator, args.num_processes, args.process_id)
+    from tpu_compressed_dp.harness.loop import elastic_distributed_init
+
+    rejoin = elastic_distributed_init(args)
     ndev = len(jax.devices())
     pipelined = args.pp > 1
     dp = args.dp if args.dp is not None else ndev // (args.sp * args.tp * args.pp)
@@ -286,22 +287,31 @@ def run(args) -> Dict[str, float]:
         method=comp.method or "none", compress=args.compress, mode=args.mode,
         transport=args.transport, seq_len=args.seq_len,
         global_batch=args.global_batch, steps=args.steps)
-    if getattr(args, "elastic", False):
-        if args.sp * args.tp * args.pp != 1:
-            raise ValueError(
-                "--elastic supports the pure data-parallel mesh; losing a "
-                "worker of a sp/tp/pp mesh orphans a model shard (that is "
-                "a checkpoint restart, not a remesh)")
-        if jax.process_count() > 1:
-            raise ValueError(
-                "--elastic drives the single-process simulation (one mesh "
-                "device per worker); real multi-host abort is a process "
-                "exit + watchdog relaunch into the remesh barrier")
+    if getattr(args, "elastic", False) and pipelined:
+        # dp x sp and dp x tp remesh by deleting the dead DATA row (the
+        # model shards are replicated across data rows); a pipeline stage
+        # has no replica to recover from, so pp stays a checkpoint restart
+        raise ValueError(
+            "--elastic supports dp/dp x sp/dp x tp meshes; losing a worker "
+            "of a pp mesh orphans a pipeline stage (that is a checkpoint "
+            "restart, not a remesh)")
     from tpu_compressed_dp.harness.loop import build_elastic
     from tpu_compressed_dp.train.lm_step import place_lm_state
 
-    el = build_elastic(args, mesh, chaos=chaos, events=events,
-                       place=lambda s, m: place_lm_state(s, cfg, comp, m))
+    el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events,
+                       place=lambda s, m: place_lm_state(s, cfg, comp, m),
+                       ef_axes=("data", "seq"))
+    if el is not None and rejoin is not None:
+        # watchdog-relaunched host: adopt the running world's replicated
+        # state from the re-elected coordinator's broadcast (EF rows start
+        # at zero) and retrace the step on the post-join mesh
+        state = el.join_world(state, rejoin)
+        mesh = el.mesh
+        dp = el.world
+        train_step = make_lm_train_step(cfg, opt, comp, mesh,
+                                        clip_norm=args.clip_norm,
+                                        clip_sent_norm=args.clip_sent_norm,
+                                        guard_cfg=guard_cfg, chaos=chaos)
     # --profile_epoch: trace the Nth log window.  ExitStack (not a `with`)
     # because the window opens and closes mid-loop; the outer finally
     # guarantees the stop even when the loop raises inside the window —
@@ -446,6 +456,25 @@ def run(args) -> Dict[str, float]:
                     # the log window's device_get drain + export work is not the
                     # next step's input-pipeline wait
                     timeline.resume()
+                if el is not None and (step_i + 1) % args.log_every == 0:
+                    # log-cadence readmission: fold any watchdog-relaunched
+                    # host parked in the rendezvous join barrier into a new
+                    # world epoch (no-op single-process / no joins pending)
+                    state, grew = el.rejoin_barrier(state)
+                    if grew:
+                        mesh = el.mesh
+                        dp = el.world
+                        world = dp * args.sp
+                        rows = (args.global_batch // dp) * dp
+                        train_step = make_lm_train_step(
+                            cfg, opt, comp, mesh,
+                            clip_norm=args.clip_norm,
+                            clip_sent_norm=args.clip_sent_norm,
+                            guard_cfg=guard_cfg, chaos=chaos)
+                        warm_until = step_i + 2  # compile pair on the new mesh
+                        t0 = time.time()
+                        timed_from = step_i + 1
+                        timeline.resume()
             except Exception as err:  # noqa: BLE001 - converted or re-raised
                 failure = el.failure_from(err) if el is not None else None
                 if failure is None:

@@ -22,7 +22,8 @@ from tpu_compressed_dp.utils.timer import Timer
 __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
            "comm_summary", "guard_summary", "add_robustness_args",
            "add_telemetry_args", "build_robustness", "build_elastic",
-           "make_heartbeat", "make_event_stream", "profile_trace"]
+           "elastic_distributed_init", "make_heartbeat", "make_event_stream",
+           "profile_trace"]
 
 
 @contextlib.contextmanager
@@ -151,16 +152,21 @@ def build_robustness(args, dtype):
     return guard_cfg, chaos, maybe_crash_injector(chaos)
 
 
-def build_elastic(args, mesh, *, chaos=None, events=None, place=None):
+def build_elastic(args, mesh, *, chaos=None, crash=None, events=None,
+                  place=None, ef_axes=("data",)):
     """Resolve the ``--elastic*`` CLI surface into a started
     :class:`~tpu_compressed_dp.train.elastic.ElasticRuntime` (or None).
 
     The gossip plane only arms when ``--elastic_dir`` names the shared
     directory; the chaos-conversion and bounded-fetch detection planes are
     always on.  ``--chaos peer_timeout=<s>`` (the drill's knob) overrides
-    ``--peer_timeout``.  Raises on non-data meshes — elastic remesh is a
-    data-parallel membership change; sp/tp/pp meshes would need resharding
-    model state too.
+    ``--peer_timeout``.  ``crash`` (the armed CrashInjector) lets the
+    runtime probe the ``during_remesh`` chaos phase so cascading failures
+    are drillable; ``ef_axes`` names the mesh axes the gradient sync spans
+    (the LM harness passes ``('data', 'seq')``).  Under a real
+    multi-process run the rendezvous plane arms too (same shared
+    directory), enabling the coordinated ``jax.distributed`` re-init on
+    peer death (train/rendezvous.py).
     """
     if not getattr(args, "elastic", False):
         return None
@@ -175,6 +181,7 @@ def build_elastic(args, mesh, *, chaos=None, events=None, place=None):
         peer_timeout_s=timeout, min_world=args.elastic_min_world,
         ef_policy=args.elastic_ef)
     gossip = None
+    rendezvous = None
     if cfg.gossip_dir:
         # gossip is a PROCESS-level plane: one rank per host process, each
         # writing its own liveness file (ElasticRuntime.poll beats it).
@@ -183,8 +190,46 @@ def build_elastic(args, mesh, *, chaos=None, events=None, place=None):
         # chaos plane's job (drills simulate gossip peers directly).
         gossip = PeerGossip(cfg.gossip_dir, cfg.rank, jax.process_count(),
                             peer_timeout_s=cfg.peer_timeout_s)
+        if jax.process_count() > 1:
+            from tpu_compressed_dp.train.rendezvous import Rendezvous
+            rendezvous = Rendezvous(cfg.gossip_dir, cfg.rank)
     return ElasticRuntime(cfg, mesh, chaos=chaos, gossip=gossip,
-                          events=events, place=place)
+                          events=events, place=place, crash=crash,
+                          rendezvous=rendezvous, ef_axes=tuple(ef_axes))
+
+
+def elastic_distributed_init(args):
+    """Multi-host rendezvous with elastic rejoin, replacing the harnesses'
+    bare ``distributed_init`` call.
+
+    A watchdog-relaunched host carries the running world's epoch in its
+    environment (``TCDP_RENDEZVOUS_EPOCH``, exported by ``tools/watchdog.py
+    --relaunch --elastic_dir``): instead of forming a fresh world from its
+    stale ``--coordinator/--num_processes`` flags, it parks in the
+    rendezvous join barrier until the survivors commit an epoch that
+    readmits it, then initialises against the re-elected coordinator.
+    Returns the :class:`~tpu_compressed_dp.train.rendezvous.EpochDecision`
+    it joined under (the harness hands it to ``ElasticRuntime.join_world``
+    to adopt the survivors' replicated state), or None on a fresh launch.
+    A blown join deadline raises — the process exits nonzero and the
+    watchdog's backoff is the park-and-retry loop.
+    """
+    from tpu_compressed_dp.parallel.mesh import distributed_init
+    from tpu_compressed_dp.train.rendezvous import maybe_rejoin_from_env
+
+    rank = getattr(args, "process_id", None)
+    decision = maybe_rejoin_from_env(
+        getattr(args, "elastic_dir", None),
+        0 if rank is None else int(rank),
+        deadline_s=4 * getattr(args, "peer_timeout", 60.0))
+    if decision is not None:
+        distributed_init(decision.address, decision.num_processes,
+                         decision.process_id)
+        return decision
+    distributed_init(getattr(args, "coordinator", None),
+                     getattr(args, "num_processes", None),
+                     getattr(args, "process_id", None))
+    return None
 
 
 def comm_summary(acc: "MetricAccumulator") -> Dict[str, float]:

@@ -170,6 +170,9 @@ declare("elastic/dropped_ef_norm", COUNTER, "l2", "max", "host",
         "the drop policy (0 under fold)")
 declare("elastic/remesh_latency_ms", TIMING, "ms", "mean", "host",
         "host latency of the latest remesh (state migration + re-place)")
+declare("elastic/remesh_ms", TIMING, "ms", "max", "host",
+        "cumulative training downtime spent in elastic world transitions "
+        "(remesh + rendezvous re-init + readmission) over the run")
 
 
 def canonical(key: str) -> str:

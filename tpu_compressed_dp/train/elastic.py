@@ -85,6 +85,7 @@ from tpu_compressed_dp.utils.resilience import read_heartbeat
 __all__ = [
     "PeerFailed", "ElasticConfig", "PeerGossip", "ElasticRuntime",
     "heartbeat_path", "write_peer_heartbeat", "fetch_with_timeout",
+    "abandoned_fetch_count",
     "surviving_mesh", "extended_mesh", "migrate_ef", "migrate_comp",
     "expand_ef", "expand_comp", "shrink_state", "expand_state",
     "TrimBatches",
@@ -177,9 +178,9 @@ class PeerGossip:
     """Decentralised failure detector over a shared heartbeat directory.
 
     Each worker runs one instance: it reads every peer's file per
-    :meth:`check` and votes a peer dead once no FRESH record (recent ``ts``
-    AND the admitted incarnation) has been seen for ``peer_timeout_s``.
-    Incarnation rules:
+    :meth:`check` and votes a peer dead once no FRESH record (a changed
+    record under the admitted incarnation) has been seen for
+    ``peer_timeout_s`` of local monotonic time.  Incarnation rules:
 
       * the first record seen for a rank admits its incarnation;
       * a record with a LOWER incarnation than admitted is a stale file of
@@ -191,12 +192,23 @@ class PeerGossip:
 
     Construction starts every peer's grace clock at "now", so a cold start
     where peers appear over ``peer_timeout_s`` does not false-positive.
+
+    Clock discipline: staleness is measured on THIS process's monotonic
+    clock, and a peer is fresh when its record *changed* since the last
+    sweep — the writer's wall-clock ``ts`` is never compared against local
+    time.  An NTP step (or plain cross-host clock skew) therefore cannot
+    mass-declare live peers dead: as long as a peer keeps rewriting its
+    file (``beat`` rewrites at least every ``peer_timeout_s / 4``), it
+    keeps reading as alive no matter what its timestamps claim.  The one
+    cost is that a pre-existing stale file buys its dead writer a single
+    extra timeout window at first observation (it reads as a change) —
+    the same grace a cold start already grants.
     """
 
     def __init__(self, gossip_dir: str, rank: int, world: int, *,
                  peer_timeout_s: float = DEFAULT_PEER_TIMEOUT_S,
                  incarnation: Optional[int] = None,
-                 now: Callable[[], float] = time.time):
+                 now: Callable[[], float] = time.monotonic):
         self.gossip_dir = gossip_dir
         self.rank = int(rank)
         self.world = int(world)
@@ -212,6 +224,7 @@ class PeerGossip:
         t0 = now()
         self._last_fresh: Dict[int, float] = {
             r: t0 for r in range(self.world)}
+        self._last_rec: Dict[int, Tuple] = {}    # rank -> last observed record
         self._admitted: Dict[int, Optional[int]] = {
             r: None for r in range(self.world)}
         self._dead: Dict[int, str] = {}          # rank -> reason
@@ -248,15 +261,16 @@ class PeerGossip:
                 continue
             hb = read_heartbeat(heartbeat_path(self.gossip_dir, r))
             inc = None
+            changed = False
             if hb is not None:
                 inc = int(hb.get("incarnation", 0) or 0)
-                ts = hb.get("ts")
-                fresh_ts = (isinstance(ts, (int, float))
-                            and not isinstance(ts, bool)
-                            and (now - ts) <= self.peer_timeout_s)
+                rec = (hb.get("ts"), hb.get("step"), inc)
+                changed = rec != self._last_rec.get(r)
+                if changed:
+                    self._last_rec[r] = rec
             if r in self._dead:
                 dead_inc = self._admitted.get(r)
-                if (hb is not None and fresh_ts and inc is not None
+                if (hb is not None and changed and inc is not None
                         and (dead_inc is None or inc > dead_inc)):
                     self._rejoin[r] = inc
                 continue
@@ -272,8 +286,11 @@ class PeerGossip:
                     newly[r] = why
                     self._rejoin[r] = inc
                     continue
-                if fresh_ts and inc == self._admitted[r]:
-                    self._last_fresh[r] = max(self._last_fresh[r], float(ts))
+                if changed and inc == self._admitted[r]:
+                    # liveness = "the record is still being rewritten",
+                    # stamped with OUR clock — never the writer's wall ts
+                    self._last_fresh[r] = max(self._last_fresh[r],
+                                              float(now))
             age = now - self._last_fresh[r]
             if age > self.peer_timeout_s:
                 why = (f"no fresh heartbeat for {age:.1f}s "
@@ -308,6 +325,25 @@ class PeerGossip:
 
 # ------------------------------------------------- bounded collective fetch
 
+# Timed-out fetch threads cannot be killed (a device_get blocked inside the
+# runtime has no cancellation point), but they must not LEAK: each abandoned
+# runner is tracked here and reaped (dropped from the list) as soon as it
+# finishes, and its discard flag makes it drop the fetched buffer instead of
+# pinning it in a result box nobody will ever read.
+_ABANDONED_FETCHES: List[threading.Thread] = []
+_ABANDONED_LOCK = threading.Lock()
+
+
+def abandoned_fetch_count() -> int:
+    """Live runner threads whose deadline expired (reaps finished ones
+    first).  Steady state is 0 once their blocking fetches drain — the
+    hammer test pins that repeated timeouts do not accumulate threads."""
+    with _ABANDONED_LOCK:
+        _ABANDONED_FETCHES[:] = [t for t in _ABANDONED_FETCHES
+                                 if t.is_alive()]
+        return len(_ABANDONED_FETCHES)
+
+
 def fetch_with_timeout(thunk: Callable[[], Any], timeout_s: float, *,
                        step: Optional[int] = None,
                        what: str = "collective fetch") -> Any:
@@ -318,21 +354,43 @@ def fetch_with_timeout(thunk: Callable[[], Any], timeout_s: float, *,
     thunk runs in a daemon thread; exceeding ``timeout_s`` raises
     :class:`PeerFailed` (with no culprit — gossip names the rank).  The
     thunk's own exception, if any, is re-raised on the caller's thread.
+
+    On timeout the caller marks the runner DISCARDED before abandoning it:
+    whenever the blocked fetch eventually returns, the runner drops the
+    value on the floor (no reference survives the function) instead of
+    parking a dead world's device buffers in a result box forever.  The
+    abandoned thread itself is tracked and reaped once it exits
+    (:func:`abandoned_fetch_count`).
     """
     box: Dict[str, Any] = {}
     done = threading.Event()
+    lock = threading.Lock()
+    discarded = [False]
 
     def runner():
         try:
-            box["value"] = thunk()
-        except BaseException as e:  # surfaced on the caller's thread
-            box["error"] = e
+            value = thunk()
+            with lock:
+                if not discarded[0]:
+                    box["value"] = value
+            del value
+        except BaseException as e:
+            with lock:
+                if not discarded[0]:  # nobody is left to re-raise it to
+                    box["error"] = e
         finally:
             done.set()
 
-    t = threading.Thread(target=runner, daemon=True)
+    t = threading.Thread(target=runner, daemon=True,
+                         name=f"tcdp-elastic-fetch({what})")
     t.start()
     if not done.wait(timeout_s):
+        with lock:
+            discarded[0] = True
+        with _ABANDONED_LOCK:
+            _ABANDONED_FETCHES[:] = [a for a in _ABANDONED_FETCHES
+                                     if a.is_alive()]
+            _ABANDONED_FETCHES.append(t)
         raise PeerFailed((), step=step, reason=(
             f"{what} still blocked after {timeout_s:g}s — "
             "a peer died mid-collective"))
@@ -343,59 +401,81 @@ def fetch_with_timeout(thunk: Callable[[], Any], timeout_s: float, *,
 
 # ------------------------------------------------------------ mesh surgery
 
-def _data_devices(mesh) -> List:
-    """Devices along the data axis, requiring a data-parallel-ONLY mesh:
-    either the 1-D ``('data',)`` mesh or a multi-axis mesh whose non-data
-    axes are all size 1 (the LM harness's dp-only configuration).  Losing
-    one data worker of a sheared dp x tp mesh would orphan a whole model
-    shard — that is a job restart, not a remesh."""
+def _mesh_grid(mesh) -> np.ndarray:
+    """The mesh's devices as a ``(data_rows, model_cols)`` object grid:
+    row ``i`` is data worker ``i``'s devices across every model axis
+    (tensor/sequence/pipe), flattened in axis order.  Elastic membership
+    changes are DATA-row changes — a dying host takes one data row (its
+    model shards are replicated across data rows, so survivors still hold
+    a full copy of the model); losing a model COLUMN would orphan model
+    state and stays a job restart.  The grid view is what lets the surgery
+    below work unchanged on ('data',), dp x tp, dp x sp, ... meshes."""
     names = tuple(mesh.axis_names)
     if DATA_AXIS not in names:
         raise ValueError(
             f"elastic remesh needs a '{DATA_AXIS}' axis; got axes {names}")
-    extra = {n: int(mesh.shape[n]) for n in names if n != DATA_AXIS}
-    if any(s != 1 for s in extra.values()):
-        raise ValueError(
-            "elastic remesh supports data-parallel-only meshes; got "
-            f"model axes {extra}")
-    return list(mesh.devices.reshape(-1))
+    dev = np.asarray(mesh.devices, dtype=object)
+    i = names.index(DATA_AXIS)
+    rows = int(dev.shape[i])
+    return np.moveaxis(dev, i, 0).reshape(rows, -1)
 
 
-def _rebuild_mesh(mesh, devices: Sequence):
-    """A mesh over ``devices`` with the template mesh's axis names (data
-    axis resized, unit model axes preserved so the harness's specs keep
-    resolving)."""
+def _rebuild_mesh(mesh, grid: np.ndarray):
+    """A mesh over the ``(data_rows, model_cols)`` grid with the template
+    mesh's axis names and model-axis sizes (only the data axis resizes, so
+    the harness's PartitionSpecs keep resolving on the new mesh)."""
     names = tuple(mesh.axis_names)
+    grid = np.asarray(grid, dtype=object)
     if names == (DATA_AXIS,):
-        return make_data_mesh(devices=list(devices))
-    shape = tuple(len(devices) if n == DATA_AXIS else 1 for n in names)
-    return jax.sharding.Mesh(
-        np.asarray(devices, dtype=object).reshape(shape), names)
+        return make_data_mesh(devices=list(grid.reshape(-1)))
+    i = names.index(DATA_AXIS)
+    model_shape = [int(mesh.shape[n]) for n in names if n != DATA_AXIS]
+    dev = grid.reshape([grid.shape[0]] + model_shape)
+    dev = np.moveaxis(dev, 0, i)
+    return jax.sharding.Mesh(dev, names)
+
+
+def _as_rows(new_devices: Sequence, model_cols: int) -> np.ndarray:
+    """Normalise readmitted devices to grid rows: a flat device list on a
+    dp-only mesh (one device per row), or per-row device sequences on a
+    sheared mesh."""
+    rows = []
+    for entry in new_devices:
+        row = [entry] if not isinstance(entry, (list, tuple, np.ndarray)) \
+            else list(entry)
+        if len(row) != model_cols:
+            raise ValueError(
+                f"readmitted row has {len(row)} device(s); the mesh's "
+                f"model axes need {model_cols} per data row")
+        rows.append(row)
+    return np.asarray(rows, dtype=object).reshape(len(rows), model_cols)
 
 
 def surviving_mesh(mesh, failed: Sequence[int]):
-    """The W-1 (or W-F) mesh over the survivors, order preserved; returns
-    ``(new_mesh, removed_devices)`` with the dead workers' devices parked
-    for later re-admission."""
-    devices = _data_devices(mesh)
+    """The W-1 (or W-F) mesh over the surviving data rows, order
+    preserved; returns ``(new_mesh, removed_rows)`` with each dead
+    worker's devices (a full model row) parked for later re-admission."""
+    grid = _mesh_grid(mesh)
     failed_set = {int(f) for f in failed}
-    bad = [f for f in failed_set if not 0 <= f < len(devices)]
+    bad = [f for f in failed_set if not 0 <= f < grid.shape[0]]
     if bad:
         raise ValueError(f"failed worker index {bad} outside world "
-                         f"{len(devices)}")
-    survivors = [d for i, d in enumerate(devices) if i not in failed_set]
-    removed = [devices[i] for i in sorted(failed_set)]
-    if not survivors:
+                         f"{grid.shape[0]}")
+    keep = [i for i in range(grid.shape[0]) if i not in failed_set]
+    if not keep:
         raise ValueError("no survivors to remesh over")
-    return _rebuild_mesh(mesh, survivors), removed
+    removed = [list(grid[i]) if grid.shape[1] > 1 else grid[i, 0]
+               for i in sorted(failed_set)]
+    return _rebuild_mesh(mesh, grid[keep]), removed
 
 
 def extended_mesh(mesh, new_devices: Sequence):
-    """The mesh with returning devices appended (rejoiners take the tail
+    """The mesh with returning data rows appended (rejoiners take the tail
     positions — survivor worker indices, and with them the EF rows and the
     owner partition prefix, stay stable)."""
-    devices = _data_devices(mesh)
-    return _rebuild_mesh(mesh, devices + list(new_devices))
+    grid = _mesh_grid(mesh)
+    rows = _as_rows(new_devices, grid.shape[1])
+    return _rebuild_mesh(mesh, np.concatenate([grid, rows], axis=0))
 
 
 # -------------------------------------------------------- state migration
@@ -476,27 +556,68 @@ def expand_comp(comp: Any, n_new: int = 1) -> Any:
             + [np.asarray(a)[:1]] * n_new, axis=0), comp)
 
 
+def _rows_per_data_row(tree: Any, data_world: Optional[int]) -> int:
+    """How many leading-axis rows one DATA row owns in an EF/comp tree.
+
+    The leading worker axis counts SYNC workers, which on a sheared mesh
+    is the product of every axis the gradient sync spans — e.g. the LM
+    harness's EF is ``P(('data', 'seq'), ...)``, so a dp x sp mesh has
+    ``sp`` EF rows per data row, laid out data-major (data row ``d`` owns
+    rows ``[d*sp, (d+1)*sp)``).  Derived from the leaves' actual leading
+    dim against the mesh's data extent so no extra configuration can
+    drift from the real layout."""
+    if tree == () or data_world is None:
+        return 1
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return 1
+    lead = int(np.asarray(leaves[0]).shape[0])
+    if data_world <= 0 or lead % data_world:
+        raise ValueError(
+            f"EF/comp leading axis {lead} does not divide into "
+            f"{data_world} data rows")
+    return lead // data_world
+
+
+def _worker_rows(data_rows: Sequence[int], m: int) -> List[int]:
+    """Expand failed DATA-row indices into leading-axis row indices
+    (identity when ``m == 1``, the dp-only layout)."""
+    return [int(d) * m + j for d in sorted({int(x) for x in data_rows})
+            for j in range(m)]
+
+
 def shrink_state(state, failed: Sequence[int], *, policy: str = "fold",
-                 fold_into: int = 0):
+                 fold_into: int = 0, data_world: Optional[int] = None):
     """Migrate a TrainState off the dead workers: fetch ef/comp to host,
     shrink their leading axes, keep every replicated field bitwise.
-    Returns ``(new_state, dropped_ef_norm)`` — still host-side; the caller
-    places it on the new mesh (``with_mesh_sharding``)."""
+    ``failed`` are DATA-row indices; ``data_world`` (the data extent of
+    the mesh being shrunk) translates them to leading-axis rows when the
+    sync world is wider than the data axis (dp x sp — see
+    :func:`_rows_per_data_row`); omitted, rows map 1:1.  Returns
+    ``(new_state, dropped_ef_norm)`` — still host-side; the caller places
+    it on the new mesh (``with_mesh_sharding`` / ``place_lm_state``)."""
     ef = jax.device_get(state.ef) if state.ef != () else ()
     comp = jax.device_get(state.comp) if state.comp != () else ()
-    new_ef, dropped = migrate_ef(ef, failed, policy=policy,
+    ef_rows = _worker_rows(failed, _rows_per_data_row(ef, data_world))
+    comp_rows = _worker_rows(failed, _rows_per_data_row(comp, data_world))
+    new_ef, dropped = migrate_ef(ef, ef_rows, policy=policy,
                                  fold_into=fold_into)
-    new_comp = migrate_comp(comp, failed)
+    new_comp = migrate_comp(comp, comp_rows)
     return dataclasses.replace(state, ef=new_ef, comp=new_comp), dropped
 
 
-def expand_state(state, n_new: int = 1):
-    """Extend a TrainState for ``n_new`` rejoining workers (zero EF rows,
-    broadcast-re-warmed comp rows); host-side, caller re-places."""
+def expand_state(state, n_new: int = 1, *,
+                 data_world: Optional[int] = None):
+    """Extend a TrainState for ``n_new`` rejoining DATA rows (zero EF
+    rows, broadcast-re-warmed comp rows — ``m`` leading-axis rows per data
+    row, see :func:`_rows_per_data_row` with ``data_world`` the CURRENT
+    pre-extension data extent); host-side, caller re-places."""
     ef = jax.device_get(state.ef) if state.ef != () else ()
     comp = jax.device_get(state.comp) if state.comp != () else ()
-    return dataclasses.replace(state, ef=expand_ef(ef, n_new),
-                               comp=expand_comp(comp, n_new))
+    m_ef = _rows_per_data_row(ef, data_world)
+    m_comp = _rows_per_data_row(comp, data_world)
+    return dataclasses.replace(state, ef=expand_ef(ef, n_new * m_ef),
+                               comp=expand_comp(comp, n_new * m_comp))
 
 
 class TrimBatches:
@@ -541,8 +662,10 @@ class ElasticRuntime:
     def __init__(self, cfg: ElasticConfig, mesh, *, chaos=None,
                  gossip: Optional[PeerGossip] = None, events=None,
                  place: Optional[Callable[[Any, Any], Any]] = None,
+                 crash=None, rendezvous=None,
+                 ef_axes: Tuple[str, ...] = (DATA_AXIS,),
                  log: Callable[[str], None] = print):
-        _data_devices(mesh)  # validates the mesh shape up front
+        _mesh_grid(mesh)  # validates the mesh shape up front
         self.cfg = cfg
         self.mesh = mesh
         self.chaos = chaos
@@ -552,13 +675,29 @@ class ElasticRuntime:
         # is the TrainState's own sharding rule, the LM harness passes its
         # place_lm_state closure
         self._place = place or (lambda s, m: s.with_mesh_sharding(m))
+        # the armed CrashInjector (utils/chaos.py): handle_failure probes
+        # its 'during_remesh' phase so a second death INSIDE the failure
+        # handler cascades instead of wedging
+        self.crash = crash
+        # the rendezvous handle (train/rendezvous.py) — arms the
+        # multi-process coordinated re-init path; None keeps every remesh
+        # in-process (the single-process simulation and all the drills)
+        self.rendezvous = rendezvous
+        # which mesh axes the gradient sync spans — the EF leading axis
+        # layout (the LM harness passes ('data', 'seq'))
+        self.ef_axes = tuple(ef_axes)
         self._log = log
-        self._parked: List = []            # (rank, device) of removed peers
+        self._parked: List = []            # (rank, device row) of removed peers
+        self._proc_ranks: Tuple[int, ...] = tuple(
+            range(jax.process_count()))    # surviving ORIGINAL process ranks
+        self.epoch = 0                     # last committed rendezvous epoch
         self.peer_failures = 0
         self.remesh_count = 0
+        self.cascade_count = 0             # failures converted DURING a remesh
         self.readmit_count = 0
         self.dropped_ef_norm = 0.0
         self.remesh_latency_ms = 0.0       # latest remesh's host latency
+        self.remesh_ms = 0.0               # cumulative remesh downtime
 
     @property
     def world(self) -> int:
@@ -600,10 +739,13 @@ class ElasticRuntime:
                                              f"{list(dead)}")
             return exc
         if (isinstance(exc, ChaosCrash)
-                and getattr(exc, "mode", "step") == "mid_collective"):
+                and getattr(exc, "mode", "step") in ("mid_collective",
+                                                     "during_remesh")):
             return PeerFailed((getattr(exc, "worker", 0),),
                               step=getattr(exc, "step", None),
-                              reason="chaos mid-collective kill")
+                              reason=("chaos kill during remesh"
+                                      if exc.mode == "during_remesh"
+                                      else "chaos mid-collective kill"))
         return None
 
     # -- remesh ----------------------------------------------------------
@@ -613,41 +755,92 @@ class ElasticRuntime:
         migrate EF/comp per the configured policy, re-place the state, and
         account the event.  Returns the state ON the new mesh; the caller
         must rebuild its jitted steps against :attr:`mesh` (which is how
-        the sharded transport's owner partition gets recomputed)."""
+        the sharded transport's owner partition gets recomputed).
+
+        Cascading failures: a peer dying while survivors are INSIDE this
+        handler (the ``crash=during_remesh`` chaos phase plays it
+        deterministically) re-enters failure handling — the dead set is
+        unioned and the shrink restarts from the still-uncommitted
+        original mesh/state, down to ``min_world``, instead of committing
+        a world that is already stale.
+
+        Under ``jax.process_count() > 1`` with a rendezvous armed, the
+        commit goes through the coordinated re-init path
+        (:meth:`_handle_failure_multiprocess`) — survivors agree on a new
+        epoch, tear down and re-run ``jax.distributed.initialize`` over
+        the reduced process set, then rebuild the mesh and state on the
+        new runtime."""
+        from tpu_compressed_dp.utils.chaos import ChaosCrash
+
         if not failure.failed:
             raise failure
-        new_world = self.world - len(set(failure.failed))
-        if new_world < self.cfg.min_world:
-            raise PeerFailed(
-                failure.failed, step=failure.step,
-                reason=(f"{failure.reason}; surviving world {new_world} "
-                        f"below min_world {self.cfg.min_world} — "
-                        "not remeshing"))
+        if self.rendezvous is not None and jax.process_count() > 1:
+            return self._handle_failure_multiprocess(state, failure)
+        failed = {int(f) for f in failure.failed}
+        reason = failure.reason
         t0 = time.monotonic()
-        new_mesh, removed = surviving_mesh(self.mesh, failure.failed)
-        state, dropped = shrink_state(state, failure.failed,
-                                      policy=self.cfg.ef_policy,
-                                      fold_into=fold_into)
-        state = self._place(state, new_mesh)
-        self._parked.extend(zip(sorted(set(failure.failed)), removed))
+        while True:
+            new_world = self.world - len(failed)
+            if new_world < self.cfg.min_world:
+                raise PeerFailed(
+                    sorted(failed), step=failure.step,
+                    reason=(f"{reason}; surviving world {new_world} "
+                            f"below min_world {self.cfg.min_world} — "
+                            "not remeshing"))
+            new_mesh, removed = surviving_mesh(self.mesh, sorted(failed))
+            new_state, dropped = shrink_state(
+                state, sorted(failed), policy=self.cfg.ef_policy,
+                fold_into=fold_into, data_world=self.world)
+            # a second death while we are mid-remesh: probe the chaos
+            # injector's during_remesh phase BEFORE committing — the
+            # shrink restarts with the union against the original mesh
+            if self.crash is not None:
+                try:
+                    probe = (failure.step if failure.step is not None
+                             else getattr(self.crash, "crash_at_step", 0))
+                    self.crash.check(probe, phase="during_remesh")
+                except ChaosCrash as e:
+                    more = self.failure_from(e)
+                    if more is not None and more.failed:
+                        extra = set(more.failed) - failed
+                        failed |= set(more.failed)
+                        self.cascade_count += 1
+                        self.peer_failures += len(extra)
+                        reason = f"{reason}; then {more.reason}"
+                        self._log("elastic: peer(s) "
+                                  f"{sorted(more.failed)} died during the "
+                                  "remesh — re-entering failure handling "
+                                  f"over {sorted(failed)}")
+                        if self.events is not None:
+                            self.events.emit(
+                                "remesh_cascade", step=failure.step,
+                                failed=sorted(failed),
+                                added=sorted(extra))
+                        continue
+            break
+        state = self._place(new_state, new_mesh)
+        self._parked.extend(zip(sorted(failed), removed))
+        old_world = self.world
         self.mesh = new_mesh
         if self.gossip is not None:
-            self.gossip.note_dead(failure.failed, failure.reason)
+            self.gossip.note_dead(failed, reason)
         self.peer_failures += len(set(failure.failed))
         self.remesh_count += 1
         self.dropped_ef_norm += dropped
         self.remesh_latency_ms = (time.monotonic() - t0) * 1e3
-        self._log(f"elastic: remeshed {new_world + len(set(failure.failed))}"
-                  f" -> {new_world} workers after {failure.reason} "
+        self.remesh_ms += self.remesh_latency_ms
+        self._log(f"elastic: remeshed {old_world}"
+                  f" -> {new_world} workers after {reason} "
                   f"(ef={self.cfg.ef_policy}"
                   + (f", dropped ‖ef‖={dropped:.3e}" if dropped else "")
                   + f", {self.remesh_latency_ms:.0f} ms)")
         if self.events is not None:
             self.events.emit(
-                "remesh", step=failure.step, failed=list(failure.failed),
+                "remesh", step=failure.step, failed=sorted(failed),
                 world=new_world, ef_policy=self.cfg.ef_policy,
                 dropped_ef_norm=float(dropped),
-                latency_ms=self.remesh_latency_ms)
+                latency_ms=self.remesh_latency_ms,
+                remesh_ms=self.remesh_ms)
         return state
 
     # -- re-admission ----------------------------------------------------
@@ -660,11 +853,14 @@ class ElasticRuntime:
         n = len(self._parked) if n is None else min(int(n), len(self._parked))
         if n <= 0:
             return state
+        t0 = time.monotonic()
         back, self._parked = self._parked[:n], self._parked[n:]
         ranks = [r for r, _ in back]
         new_mesh = extended_mesh(self.mesh, [d for _, d in back])
-        state = self._place(expand_state(state, n_new=n), new_mesh)
+        state = self._place(
+            expand_state(state, n_new=n, data_world=self.world), new_mesh)
         self.mesh = new_mesh
+        self.remesh_ms += (time.monotonic() - t0) * 1e3
         self.readmit_count += n
         if self.gossip is not None:
             for r in ranks:
@@ -680,6 +876,224 @@ class ElasticRuntime:
         """Ranks currently removed from the mesh (readmission pool)."""
         return tuple(r for r, _ in self._parked)
 
+    # -- multi-process world transitions ---------------------------------
+    # These paths only run under jax.process_count() > 1 with a rendezvous
+    # armed; they are exercised by the HAS_CPU_MULTIPROCESS-gated 2-process
+    # drills (tests/test_elastic_multiprocess.py).  The pure pieces (rank ->
+    # row maps, local-shard gathers) are unit tested single-process.
+
+    def _proc_data_rows(self, ranks: Iterable[int]) -> List[int]:
+        """The mesh data rows owned by the given ORIGINAL process ranks
+        (contiguous blocks in surviving-rank order)."""
+        per = self.world // max(len(self._proc_ranks), 1)
+        pos = {r: i for i, r in enumerate(self._proc_ranks)}
+        return [pos[int(r)] * per + j for r in ranks for j in range(per)
+                if int(r) in pos]
+
+    def _host_snapshot(self, state):
+        """Fetch what THIS process can still read before the distributed
+        runtime is torn down: replicated fields in full (every process
+        holds a replica shard), EF/comp as the locally-addressable leading
+        rows.  Never touches non-addressable shards — those live(d) on
+        peers and fetching them is exactly the hang we are escaping."""
+        def full(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                return np.asarray(x.addressable_data(0))
+            return jax.device_get(x)
+
+        def local_rows(x):
+            x_arr = x
+            shards = sorted(x_arr.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            rows = [np.asarray(s.data) for s in shards]
+            if any(r.shape[1:] != tuple(x_arr.shape[1:]) for r in rows):
+                raise NotImplementedError(
+                    "multi-process elastic re-init supports EF/comp "
+                    "sharded on the leading worker axis only; trailing "
+                    "model-axis shards (dp x tp multi-host) need a full "
+                    "restart")
+            return np.concatenate(rows, axis=0)
+
+        repl = jax.tree.map(full, dataclasses.replace(state, ef=(), comp=()))
+        ef = (jax.tree.map(local_rows, state.ef)
+              if state.ef != () else ())
+        comp = (jax.tree.map(local_rows, state.comp)
+                if state.comp != () else ())
+        return repl, ef, comp
+
+    def _assemble_multiprocess(self, repl, local_ef, local_comp, mesh):
+        """Rebuild a global TrainState on a freshly re-initialised runtime:
+        replicated fields place through the harness's place callback (every
+        process holds the full value), EF/comp reassemble from each
+        process's local rows (``make_array_from_process_local_data``)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        state = self._place(repl, mesh)
+        sharding = NamedSharding(mesh, PartitionSpec(self.ef_axes))
+        lead = int(mesh.shape[DATA_AXIS]) * int(
+            np.prod([mesh.shape[a] for a in self.ef_axes
+                     if a != DATA_AXIS] or [1]))
+
+        def assemble(rows):
+            rows = np.asarray(rows)
+            return jax.make_array_from_process_local_data(
+                sharding, rows, (lead,) + rows.shape[1:])
+
+        ef = (jax.tree.map(assemble, local_ef) if local_ef != () else ())
+        comp = (jax.tree.map(assemble, local_comp)
+                if local_comp != () else ())
+        return dataclasses.replace(state, ef=ef, comp=comp)
+
+    def _handle_failure_multiprocess(self, state, failure: PeerFailed):
+        """Coordinated multi-process shrink: snapshot local state, agree on
+        the surviving world through the rendezvous, re-init
+        ``jax.distributed`` over it, rebuild mesh + state.
+
+        ``failure.failed`` are ORIGINAL process ranks (the gossip plane's
+        currency).  The dead processes' EF rows lived only in their memory
+        and are unrecoverable — multi-process death always behaves like
+        the ``drop`` policy with an unknowable norm (logged, and flagged
+        on the remesh event), whatever ``ef_policy`` says."""
+        from tpu_compressed_dp.train.rendezvous import reinit_distributed
+
+        t0 = time.monotonic()
+        dead = {int(f) for f in failure.failed}
+        live = [r for r in self._proc_ranks if r not in dead]
+        if self.cfg.rank not in live:
+            raise PeerFailed(sorted(dead), step=failure.step,
+                             reason=f"{failure.reason}; this rank is among "
+                                    "the declared dead — exiting for the "
+                                    "watchdog")
+        grid = _mesh_grid(self.mesh)
+        dead_rows = self._proc_data_rows(dead)
+        new_world = self.world - len(dead_rows)
+        if new_world < self.cfg.min_world:
+            raise PeerFailed(
+                sorted(dead), step=failure.step,
+                reason=(f"{failure.reason}; surviving world {new_world} "
+                        f"below min_world {self.cfg.min_world} — "
+                        "not remeshing"))
+        repl, local_ef, local_comp = self._host_snapshot(state)
+        decision = self.rendezvous.propose(
+            live, deadline_s=self.cfg.peer_timeout_s * 4)
+        reinit_distributed(decision, log=self._log)
+        new_grid = np.asarray(jax.devices(), dtype=object).reshape(
+            -1, grid.shape[1])
+        new_mesh = _rebuild_mesh(self.mesh, new_grid)
+        state = self._assemble_multiprocess(repl, local_ef, local_comp,
+                                            new_mesh)
+        self.mesh = new_mesh
+        self._proc_ranks = decision.ranks
+        self.epoch = decision.epoch
+        if self.gossip is not None:
+            self.gossip.note_dead(dead, failure.reason)
+        self.peer_failures += len(dead)
+        self.remesh_count += 1
+        self.remesh_latency_ms = (time.monotonic() - t0) * 1e3
+        self.remesh_ms += self.remesh_latency_ms
+        self._log(f"elastic: epoch {decision.epoch}: re-initialised "
+                  f"{len(live) + len(dead)} -> {len(live)} processes "
+                  f"(world {new_world}) after {failure.reason}; dead "
+                  "peers' EF rows unrecoverable (dropped, norm unknown); "
+                  f"{self.remesh_latency_ms:.0f} ms")
+        if self.events is not None:
+            self.events.emit(
+                "remesh", step=failure.step, failed=sorted(dead),
+                world=new_world, epoch=decision.epoch,
+                ef_policy="drop", ef_unrecoverable=True,
+                dropped_ef_norm=float("nan"),
+                latency_ms=self.remesh_latency_ms,
+                remesh_ms=self.remesh_ms)
+        return state
+
+    def rejoin_barrier(self, state):
+        """Survivor half of multi-process scale-up, called at an epoch
+        boundary: fold pending join requests (watchdog-relaunched hosts
+        waiting in :meth:`Rendezvous.join`) into a new world epoch,
+        re-init, and rebuild with zero EF rows for the joiners (their rows
+        arrive via each process's local contribution — the joiner's own
+        :meth:`join_world` supplies zeros).  Returns ``(state, changed)``;
+        the caller rebuilds its jitted steps when ``changed``."""
+        if self.rendezvous is None or jax.process_count() <= 1:
+            return state, False
+        joins = self.rendezvous.pending_joins()
+        ready = sorted(set(joins) - set(self._proc_ranks))
+        if not ready:
+            return state, False
+        t0 = time.monotonic()
+        repl, local_ef, local_comp = self._host_snapshot(state)
+        new_ranks = sorted(set(self._proc_ranks) | set(ready))
+        from jax.experimental import multihost_utils
+
+        from tpu_compressed_dp.train.rendezvous import reinit_distributed
+        # only survivors vote (the joiners are parked in Rendezvous.join);
+        # the coordinator is therefore a survivor — the broadcast source
+        # of the replicated state the joiners are missing
+        decision = self.rendezvous.propose(
+            new_ranks, voters=self._proc_ranks,
+            deadline_s=self.cfg.peer_timeout_s * 4)
+        reinit_distributed(decision, log=self._log)
+        src = decision.ranks.index(decision.coordinator)
+        repl = multihost_utils.broadcast_one_to_all(
+            repl, is_source=decision.process_id == src)
+        if local_comp != ():
+            # comp rows are identical across workers by construction, so
+            # the coordinator's local rows re-warm the joiners' too
+            local_comp = multihost_utils.broadcast_one_to_all(
+                local_comp, is_source=decision.process_id == src)
+        grid_cols = _mesh_grid(self.mesh).shape[1]
+        new_grid = np.asarray(jax.devices(), dtype=object).reshape(
+            -1, grid_cols)
+        new_mesh = _rebuild_mesh(self.mesh, new_grid)
+        state = self._assemble_multiprocess(repl, local_ef, local_comp,
+                                            new_mesh)
+        self.mesh = new_mesh
+        self._proc_ranks = tuple(decision.ranks)
+        self.epoch = decision.epoch
+        self.readmit_count += len(ready)
+        if self.gossip is not None:
+            for r in ready:
+                self.gossip.readmit(r)
+        self.remesh_ms += (time.monotonic() - t0) * 1e3
+        self._log(f"elastic: epoch {decision.epoch}: readmitted process(es) "
+                  f"{ready} -> world {self.world}")
+        if self.events is not None:
+            self.events.emit("readmit", ranks=ready, world=self.world,
+                             epoch=decision.epoch)
+        return state, True
+
+    def join_world(self, state, decision):
+        """Joiner half of multi-process scale-up: called by a relaunched
+        harness right after init, with the :class:`EpochDecision` its
+        rendezvous join returned.  The fresh-init state supplies shapes;
+        replicated values are adopted from the survivors' broadcast and
+        the EF rows start at zero (a rejoiner has withheld nothing)."""
+        from jax.experimental import multihost_utils
+
+        repl, local_ef, local_comp = self._host_snapshot(state)
+        # the re-elected coordinator (a survivor) is the source of truth
+        # for every replicated field and the comp re-warm; our fresh-init
+        # values are discarded
+        src = decision.ranks.index(decision.coordinator)
+        repl = multihost_utils.broadcast_one_to_all(
+            repl, is_source=decision.process_id == src)
+        if local_comp != ():
+            local_comp = multihost_utils.broadcast_one_to_all(
+                local_comp, is_source=decision.process_id == src)
+        local_ef = jax.tree.map(np.zeros_like, local_ef)
+        grid_cols = _mesh_grid(self.mesh).shape[1]
+        new_grid = np.asarray(jax.devices(), dtype=object).reshape(
+            -1, grid_cols)
+        new_mesh = _rebuild_mesh(self.mesh, new_grid)
+        state = self._assemble_multiprocess(repl, local_ef, local_comp,
+                                            new_mesh)
+        self.mesh = new_mesh
+        self._proc_ranks = tuple(decision.ranks)
+        self.epoch = decision.epoch
+        self._log(f"elastic: rejoined world epoch {decision.epoch} as "
+                  f"process {decision.process_id}/{decision.num_processes}")
+        return state
+
     # -- accounting ------------------------------------------------------
     def metrics(self) -> Dict[str, float]:
         """The declared ``elastic/*`` keys (obs/registry.py) for the
@@ -689,4 +1103,5 @@ class ElasticRuntime:
             "elastic/remesh_count": float(self.remesh_count),
             "elastic/dropped_ef_norm": float(self.dropped_ef_norm),
             "elastic/remesh_latency_ms": float(self.remesh_latency_ms),
+            "elastic/remesh_ms": float(self.remesh_ms),
         }

@@ -23,6 +23,7 @@ comma-separated ``key=value`` tokens (a bare ``nan``/``inf`` sets ``kind``):
     --chaos "inf,target=loss,every=50"
     --chaos "crash=120"                  # host crash only, no in-graph fault
     --chaos "crash=mid_collective,crash_at_step=12,worker=3"
+    --chaos "crash=during_remesh,crash_at_step=12,worker=3"
     --chaos "peer_timeout=0.5"           # elastic: tighten gossip staleness
 
 ``crash=mid_collective`` arms the host crash in the **collective phase**:
@@ -30,8 +31,14 @@ the injector fires *after* the step has been dispatched (its collectives
 are genuinely in flight under async dispatch) instead of before — the
 deterministic stand-in for a worker dying inside an allreduce, consumed by
 the elastic runtime (:mod:`tpu_compressed_dp.train.elastic`) as a simulated
-peer failure of ``worker``.  Like every other fault here it is keyed off
-the step counter, so a restored replay reproduces it exactly.
+peer failure of ``worker``.  ``crash=during_remesh`` arms the **remesh
+phase**: the injector fires while survivors are inside
+``ElasticRuntime.handle_failure`` — a SECOND worker dying during the
+recovery from the first, the cascading-failure case the runtime must
+re-enter failure handling for (unioned dead set, shrink restarted) rather
+than committing a world that is already stale.  Like every other fault
+here both are keyed off the step counter, so a restored replay reproduces
+them exactly.
 
 ``tools/chaos_drill.py`` runs the full injection matrix and asserts the
 guard's invariants.
@@ -75,6 +82,10 @@ class ChaosConfig:
                     crash) | 'mid_collective' (raise after dispatch, while
                     the step's collectives are in flight; the elastic
                     runtime interprets it as ``worker`` dying mid-allreduce)
+                    | 'during_remesh' (raise inside the elastic failure
+                    handler — a second worker dying while survivors are
+                    already remeshing; the runtime unions the dead set and
+                    re-enters failure handling)
     peer_timeout:   elastic failure-detection budget in seconds: a peer
                     heartbeat older than this counts as dead, and a blocked
                     device fetch longer than this raises PeerFailed
@@ -98,9 +109,9 @@ class ChaosConfig:
                 f"chaos target must be grads|loss, got {self.target!r}")
         if self.every < 0 or self.worker < 0:
             raise ValueError("chaos every/worker must be >= 0")
-        if self.crash_mode not in ("step", "mid_collective"):
-            raise ValueError("chaos crash_mode must be step|mid_collective, "
-                             f"got {self.crash_mode!r}")
+        if self.crash_mode not in ("step", "mid_collective", "during_remesh"):
+            raise ValueError("chaos crash_mode must be step|mid_collective|"
+                             f"during_remesh, got {self.crash_mode!r}")
         if self.peer_timeout < 0:
             raise ValueError("chaos peer_timeout must be >= 0")
 
@@ -129,10 +140,10 @@ class ChaosConfig:
                 kw["steps"] = tuple(int(s) for s in v.split("+") if s)
             elif k in ("every", "worker"):
                 kw[k] = int(v)
-            elif k == "crash" and v == "mid_collective":
+            elif k == "crash" and v in ("mid_collective", "during_remesh"):
                 # mode selector rides the crash key; the step itself comes
                 # from a separate crash_at_step=N token
-                kw["crash_mode"] = "mid_collective"
+                kw["crash_mode"] = v
             elif k in ("crash", "crash_at_step"):
                 kw["crash_at_step"] = int(v)
             elif k == "crash_mode":
