@@ -29,6 +29,11 @@ def test_quick_drill(mesh8):
     assert results["elastic_gossip"]["detected"] == [2]
     assert results["elastic_remesh"]["world"] == 7
     assert results["elastic_remesh"]["dropped_ef_norm"] == 0.0  # fold policy
+    # ISSUE 9 acceptance rows: preempt -> emergency save -> bitwise resume;
+    # corrupt latest -> one-step rollback to the last verifiable save
+    assert results["ckpt_preempt"]["resumed_from"] == 3
+    assert results["ckpt_preempt"]["bitwise"] is True
+    assert results["ckpt_corrupt"]["rollback_steps"] == 1
 
 
 @pytest.mark.quick

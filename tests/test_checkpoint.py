@@ -1,0 +1,331 @@
+"""Async checkpointing (ISSUE 9): non-blocking saves, checksummed manifests,
+walk-back restore, best-step GC pinning, and the offline fsck tool.
+
+The drills in tools/chaos_drill.py prove the end-to-end invariants (bitwise
+preempt-resume, corrupt-latest rollback); these units pin the Checkpointer's
+mechanics against its injectable write seam (``_write_payload``)."""
+
+import dataclasses
+import os
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.utils import checkpoint as ck
+from tpu_compressed_dp.utils.checkpoint import Checkpointer, CheckpointCorrupt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+pytestmark = pytest.mark.quick
+
+
+def _tiny_state():
+    from tpu_compressed_dp.train.optim import SGD
+    from tpu_compressed_dp.train.state import TrainState
+
+    params = {"w": jnp.zeros((4,))}
+    opt = SGD(lr=0.1)
+    return TrainState.create(params, {}, opt.init(params), (),
+                             jax.random.key(0))
+
+
+def _bump(state, n=1):
+    return dataclasses.replace(
+        state, step=state.step + n,
+        params={"w": state.params["w"] + float(n)})
+
+
+def _flip_byte(directory, step):
+    """Corrupt a committed step: XOR the middle byte of its largest file
+    (size-preserving, so only the digest check can catch it)."""
+    step_dir = os.path.join(directory, str(step))
+    target, size = None, -1
+    for root, _, names in os.walk(step_dir):
+        for name in names:
+            fp = os.path.join(root, name)
+            sz = os.path.getsize(fp)
+            if sz > size:
+                target, size = fp, sz
+    assert target is not None and size > 0, f"no payload file under {step_dir}"
+    with open(target, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+class TestAsyncSaves:
+    def test_save_async_nonblocking_blocked_ms_only_on_overlap(self, tmp_path):
+        """The acceptance timing test: with a fake-slow write seam,
+        save_async returns while the write is still in flight (inflight=1,
+        nothing committed), blocked_ms stays zero without overlap, and only
+        a second save arriving DURING the write accrues barrier time."""
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        entered, release = threading.Event(), threading.Event()
+        orig = ckpt._write_payload
+
+        def slow(step, payload, meta):
+            entered.set()
+            assert release.wait(timeout=10.0)
+            orig(step, payload, meta)
+
+        ckpt._write_payload = slow
+        s = _tiny_state()
+        ckpt.save_async(s, {"i": 0})
+        # returned while the writer is still parked in the seam
+        assert entered.wait(timeout=10.0)
+        m = ckpt.metrics()
+        assert m["ckpt/inflight"] == 1.0
+        assert m["ckpt/blocked_ms"] == 0.0   # no overlap -> no stall billed
+        assert m["ckpt/last_step"] == -1.0   # nothing durable yet
+        threading.Timer(0.15, release.set).start()
+        ckpt.save_async(_bump(s), {"i": 1})  # overlaps -> barriers on write 1
+        ckpt.drain()
+        m = ckpt.metrics()
+        assert m["ckpt/blocked_ms"] > 0.0
+        assert m["ckpt/inflight"] == 0.0
+        assert m["ckpt/last_step"] == 1.0
+        assert m["ckpt/save_ms"] > 0.0
+        assert ck.list_step_dirs(ckpt.directory) == [0, 1]
+        ckpt.close()
+
+    def test_overlapping_async_saves_serialize(self, tmp_path):
+        """Back-to-back save_asyncs never run their writes concurrently:
+        each spawn barriers on the previous thread, so the write spans are
+        strictly ordered (one Checkpointer owns the directory)."""
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        spans = []
+        orig = ckpt._write_payload
+
+        def tracked(step, payload, meta):
+            t0 = time.monotonic()
+            time.sleep(0.05)
+            orig(step, payload, meta)
+            spans.append((step, t0, time.monotonic()))
+
+        ckpt._write_payload = tracked
+        s = _tiny_state()
+        for n in range(3):
+            ckpt.save_async(_bump(s, n), {"i": n})
+        ckpt.close()  # drains the last write
+        assert [sp[0] for sp in spans] == [0, 1, 2]
+        for (_, _, end), (_, start, _) in zip(spans, spans[1:]):
+            assert start >= end, "async writes overlapped"
+        assert ck.list_step_dirs(ckpt.directory) == [0, 1, 2]
+
+    def test_close_never_strands_background_thread(self, tmp_path):
+        for i in range(3):
+            ckpt = Checkpointer(str(tmp_path / f"ck{i}"))
+            ckpt.save_async(_bump(_tiny_state(), i), {})
+            th = ckpt._thread
+            ckpt.close()
+            assert ckpt._thread is None
+            assert th is None or not th.is_alive()
+            # the drained write actually committed before close returned
+            assert ck.list_step_dirs(ckpt.directory) == [i]
+            assert ck.verify_step_dir(ckpt.directory, i) == []
+
+    def test_sync_save_after_async_drains_first(self, tmp_path):
+        """The emergency-save ordering: a sync save arriving during an
+        in-flight async write waits for it (accruing blocked_ms), then
+        commits its own step — both end up durable, in order."""
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        release = threading.Event()
+        calls = {"n": 0}
+        orig = ckpt._write_payload
+
+        def slow_first(step, payload, meta):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                assert release.wait(timeout=10.0)
+            orig(step, payload, meta)
+
+        ckpt._write_payload = slow_first
+        s = _tiny_state()
+        ckpt.save_async(s, {})
+        threading.Timer(0.1, release.set).start()
+        ckpt.save(_bump(s), {"emergency": True})
+        assert ck.list_step_dirs(ckpt.directory) == [0, 1]
+        assert ckpt.metrics()["ckpt/blocked_ms"] > 0.0
+        ckpt.close()
+
+    def test_async_write_error_surfaces_at_next_barrier(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+
+        def boom(step, payload, meta):
+            raise RuntimeError("disk full")
+
+        ckpt._write_payload = boom
+        s = _tiny_state()
+        ckpt.save_async(s, {})
+        with pytest.raises(RuntimeError, match="disk full"):
+            ckpt.drain()
+        # the emergency path drains non-raising and records the failure
+        ckpt.save_async(s, {})
+        ckpt.drain(raise_error=False)
+        assert isinstance(ckpt.last_save_error, RuntimeError)
+        assert ckpt.metrics()["ckpt/last_step"] == -1.0  # nothing committed
+        del ckpt._write_payload  # back to the real writer
+        ckpt.save(s, {"emergency": True})
+        assert ck.list_step_dirs(ckpt.directory) == [0]
+        assert ck.verify_step_dir(ckpt.directory, 0) == []
+        ckpt.close()
+
+    def test_metric_and_heartbeat_keys_declared(self, tmp_path):
+        from tpu_compressed_dp.obs import registry as obs_registry
+
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        m = ckpt.metrics()
+        assert set(m) == {"ckpt/save_ms", "ckpt/blocked_ms", "ckpt/inflight",
+                          "ckpt/last_step", "ckpt/age_s",
+                          "ckpt/rollback_steps"}
+        assert obs_registry.undeclared(m.keys()) == []
+        hb = ckpt.heartbeat_fields()
+        assert hb["last_ckpt_step"] == -1
+        assert hb["ckpt_age_s"] >= 0.0
+        ckpt.close()
+
+
+class TestManifests:
+    def test_manifest_commit_and_verify(self, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt = Checkpointer(d)
+        ckpt.save(_tiny_state(), {"epoch": 7})
+        ckpt.close()
+        man = ck.read_manifest(d, 0)
+        assert man["v"] == ck.MANIFEST_SCHEMA
+        assert man["step"] == 0
+        assert man["files"]  # per-file sha256 + bytes
+        assert all({"sha256", "bytes"} <= set(e) for e in man["files"].values())
+        assert man["meta"]["epoch"] == 7
+        assert ck.verify_step_dir(d, 0) == []
+        _flip_byte(d, 0)
+        problems = ck.verify_step_dir(d, 0)
+        assert problems and any("digest mismatch" in p for p in problems)
+
+    def test_torn_manifest_is_a_problem_but_absent_is_legacy(self, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt = Checkpointer(d)
+        ckpt.save(_tiny_state(), {})
+        ckpt.close()
+        mp = ck.manifest_path(d, 0)
+        with open(mp, "w") as f:
+            f.write('{"v": 1, "ste')  # torn manifest commit
+        assert any("unreadable" in p for p in ck.verify_step_dir(d, 0))
+        os.remove(mp)  # pre-manifest directory: tolerated as legacy
+        assert ck.verify_step_dir(d, 0) == []
+        ckpt2 = Checkpointer(d)
+        restored, _ = ckpt2.restore(_tiny_state())
+        assert int(restored.step) == 0
+        assert ckpt2.metrics()["ckpt/rollback_steps"] == 0.0
+        ckpt2.close()
+
+    def test_restore_walks_back_past_corrupt_latest(self, tmp_path):
+        """Last-known-good fallback: a bit-flipped newest step rolls the
+        restore back one step (metric + event), while an EXPLICIT request
+        for the corrupt step raises CheckpointCorrupt."""
+        d = str(tmp_path / "ck")
+        ckpt = Checkpointer(d)
+        s = _tiny_state()
+        for n in (1, 2, 3):
+            ckpt.save(_bump(s, n), {"epoch": n})
+        ckpt.close()
+        _flip_byte(d, 3)
+        ckpt2 = Checkpointer(d)
+        rec = _Recorder()
+        ckpt2.events = rec
+        restored, meta = ckpt2.restore(_tiny_state())
+        assert int(restored.step) == 2
+        np.testing.assert_allclose(np.asarray(restored.params["w"]), 2.0)
+        assert meta["epoch"] == 2
+        assert ckpt2.metrics()["ckpt/rollback_steps"] == 1.0
+        rb = next(f for k, f in rec.events if k == "ckpt_rollback")
+        assert rb["from_step"] == 3 and rb["to_step"] == 2
+        assert rb["skipped"] and rb["skipped"][0]["step"] == 3
+        with pytest.raises(CheckpointCorrupt, match="step 3"):
+            ckpt2.restore(_tiny_state(), step=3)
+        ckpt2.close()
+
+
+class TestBestPin:
+    def test_best_step_survives_max_to_keep_gc(self, tmp_path):
+        """Satellite regression: the raw Orbax max_to_keep would evict the
+        best checkpoint after enough periodic saves; our GC pins it, and a
+        fresh process restoring LATEST re-adopts the improve-only gate."""
+        d = str(tmp_path / "ck")
+        ckpt = Checkpointer(d, max_to_keep=2)
+        s = _tiny_state()
+        assert ckpt.save_if_best(_bump(s, 1), 0.9)
+        for n in (2, 3, 4, 5):
+            ckpt.save(_bump(s, n), {"epoch": n})
+        assert ck.list_step_dirs(d) == [1, 4, 5]  # pinned best + newest 2
+        assert not os.path.exists(ck.manifest_path(d, 2))  # GC'd with its step
+        _, meta_best = ckpt.restore(_tiny_state(), step=1)
+        assert meta_best["best_metric"] == 0.9
+        ckpt.close()
+        ckpt2 = Checkpointer(d, max_to_keep=2)
+        _, meta = ckpt2.restore(_tiny_state())  # latest = 5, NOT the best
+        assert meta["best_metric"] == 0.9 and meta["best_step"] == 1
+        assert ckpt2.best_metric == 0.9 and ckpt2.best_step == 1
+        assert not ckpt2.save_if_best(_bump(s, 6), 0.5)  # not an improvement
+        ckpt2.close()
+
+
+class TestCkptFsck:
+    def _make(self, tmp_path, n=2):
+        d = str(tmp_path / "ck")
+        ckpt = Checkpointer(d)
+        s = _tiny_state()
+        for i in range(1, n + 1):
+            ckpt.save(_bump(s, i), {"epoch": i})
+        ckpt.close()
+        return d
+
+    def test_verify_list_prune_cycle(self, tmp_path, capsys):
+        from tools import ckpt_fsck as fsck
+
+        d = self._make(tmp_path)
+        assert fsck.main([d]) == 0
+        out = capsys.readouterr().out
+        assert "step 1: OK" in out and "step 2: OK" in out
+        assert fsck.main([d, "--list"]) == 0
+        out = capsys.readouterr().out
+        assert "files" in out and "meta[epoch" in out
+        # a flipped byte + an orphaned manifest (step dir gone)
+        _flip_byte(d, 2)
+        with open(os.path.join(d, "manifest-9.json"), "w") as f:
+            f.write("{}")
+        assert fsck.main([d]) == 1
+        out = capsys.readouterr().out
+        assert "step 2: CORRUPT" in out and "digest mismatch" in out
+        assert "orphaned manifest" in out
+        assert fsck.main([d, "--prune"]) == 0
+        assert ck.list_step_dirs(d) == [1]
+        assert not os.path.exists(ck.manifest_path(d, 2))
+        assert not os.path.exists(os.path.join(d, "manifest-9.json"))
+        assert fsck.main([d]) == 0  # clean after prune
+
+    def test_missing_or_empty_directory_exit_2(self, tmp_path, capsys):
+        from tools import ckpt_fsck as fsck
+
+        assert fsck.main([str(tmp_path / "nope")]) == 2
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert fsck.main([str(empty)]) == 2
+        out = capsys.readouterr().out
+        assert "no such directory" in out and "no checkpoints" in out

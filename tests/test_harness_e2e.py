@@ -37,11 +37,12 @@ def test_compressed_topk_layerwise_learns(tmp_path, mesh8):
     heartbeat telemetry must come out parseable and complete."""
     ev_path = str(tmp_path / "events.jsonl")
     hb_path = str(tmp_path / "hb.json")
+    ck_dir = str(tmp_path / "ck")
     summary = run_dawn(
         tmp_path, epochs=3, compress="layerwise", method="Topk", ratio=0.1,
         error_feedback=True, momentum=0.9, guard=True,
         events=ev_path, prom=str(tmp_path / "metrics.prom"),
-        heartbeat=hb_path,
+        heartbeat=hb_path, checkpoint_dir=ck_dir,
     )
     assert summary["train acc"] > 0.5
     assert 0.0 < summary["sent frac"] < 0.2  # ~10% of elements sent
@@ -83,6 +84,22 @@ def test_compressed_topk_layerwise_learns(tmp_path, mesh8):
     assert rec["telemetry"]["step_p95_ms"] > 0
     assert wd.main(["--check", "--heartbeat", hb_path,
                     "--max_age", "300", "--max_wedge", "10"]) == 0
+
+    # checkpoint telemetry rides the same surfaces: the heartbeat carries
+    # the --max_ckpt_age fields, prometheus the ckpt/* gauges, the events
+    # stream the ckpt_save records, and the per-epoch async saves left
+    # verifiable manifests behind
+    from tpu_compressed_dp.utils import checkpoint as ckmod
+
+    assert rec["last_ckpt_step"] >= 0 and rec["ckpt_age_s"] >= 0.0
+    assert wd.main(["--check", "--heartbeat", hb_path,
+                    "--max_ckpt_age", "3600"]) == 0
+    assert "tcdp_ckpt_last_step" in prom and "tcdp_ckpt_save_ms" in prom
+    saves = [e for e in events if e["kind"] == "ckpt_save"]
+    assert saves and all(e["mode"] == "async" for e in saves)
+    steps = ckmod.list_step_dirs(ck_dir)
+    assert steps
+    assert ckmod.verify_step_dir(ck_dir, steps[-1]) == []
 
 
 def test_compressed_entiremodel_qsgd(tmp_path, mesh8):
@@ -132,6 +149,30 @@ def test_chaos_flag_arms_guard_and_run_survives(tmp_path, mesh8):
     # step 1 — exactly the wedge signal a watchdog reads off this payload
     assert rec["step"] == 2
     assert rec["last_good_step"] == 1
+
+
+def test_preempt_cuts_emergency_checkpoint_and_exit_code(tmp_path, mesh8):
+    """--chaos crash=preempt self-SIGTERMs at step 3; the harness observes
+    the flag at the same step boundary, drains the in-flight epoch-boundary
+    async save, cuts an emergency checkpoint, and exits PREEMPT_EXIT — the
+    code the watchdog relaunches immediately on.  (The bitwise-resume half
+    is proven in tier-1 by drill_ckpt_preempt.)"""
+    from tpu_compressed_dp.utils import checkpoint as ckmod
+    from tpu_compressed_dp.utils import resilience
+
+    ck_dir = str(tmp_path / "ck")
+    with pytest.raises(SystemExit) as ei:
+        run_dawn(tmp_path, epochs=3, synthetic_n=128,
+                 chaos="crash=preempt,crash_at_step=3",
+                 checkpoint_dir=ck_dir)
+    assert ei.value.code == resilience.PREEMPT_EXIT
+    steps = ckmod.list_step_dirs(ck_dir)
+    assert steps, "no emergency checkpoint was cut"
+    # newest step is the emergency save (step 3, past the epoch-0 boundary
+    # save at step 2), flagged in its manifest meta and fully verifiable
+    man = ckmod.read_manifest(ck_dir, steps[-1])
+    assert man is not None and man["meta"].get("emergency") is True
+    assert ckmod.verify_step_dir(ck_dir, steps[-1]) == []
 
 
 def test_build_robustness_flag_wiring():

@@ -358,6 +358,19 @@ class TestWatchdog:
         assert wd.main(["--check", "--heartbeat",
                         str(tmp_path / "no.json")]) == 2
 
+    def test_max_ckpt_age_cli(self, tmp_path):
+        """--max_ckpt_age reads the Checkpointer.heartbeat_fields payload
+        the harnesses fold into the heartbeat (ISSUE 9 satellite)."""
+        import tools.watchdog as wd
+
+        p = self._hb(tmp_path, last_ckpt_step=50, ckpt_age_s=500.0)
+        assert wd.main(["--check", "--heartbeat", p,
+                        "--max_ckpt_age", "1000"]) == 0
+        assert wd.main(["--check", "--heartbeat", p,
+                        "--max_ckpt_age", "60"]) == 1
+        # without the flag the checkpoint clock is never consulted
+        assert wd.main(["--check", "--heartbeat", p]) == 0
+
 
 @pytest.mark.quick
 class TestWatchdogRelaunch:
@@ -415,6 +428,20 @@ class TestWatchdogRelaunch:
     def test_dead_childs_exit_code_propagates_on_give_up(self):
         rc, spawned, _, _ = self._drive([1], max_relaunches=0, child_rcs=[7])
         assert rc == 7 and len(spawned) == 1
+
+    def test_preempt_exit_relaunches_immediately_without_backoff(self):
+        """PREEMPT_EXIT (emergency checkpoint cut, deliberate exit) respawns
+        NOW: no backoff sleep, no kill, no consecutive-budget burn — proven
+        by a ZERO relaunch budget and an EMPTY verdict script (a consumed
+        health check would raise StopIteration)."""
+        from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT
+
+        rc, spawned, killed, sleeps = self._drive(
+            [], child_rcs=[PREEMPT_EXIT, 0], max_relaunches=0)
+        assert rc == 0
+        assert len(spawned) == 2       # respawned despite max_relaunches=0
+        assert killed == []
+        assert sleeps == [1.0, 1.0]    # two plain ticks, no backoff inserted
 
     def test_healthy_check_refills_budget_and_resets_backoff(self):
         rc, spawned, _, sleeps = self._drive([1, 0, 1], max_relaunches=2,

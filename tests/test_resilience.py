@@ -1,5 +1,8 @@
 """Failure detection + crash recovery tests."""
 
+import os
+import signal
+import threading
 import time
 
 import jax
@@ -263,3 +266,144 @@ class TestRecovery:
         # 3 consecutive failures at epoch 1 exhaust max_retries=2
         with pytest.raises(RuntimeError, match="flaky at 1"):
             run({1: 3}, epochs=3, max_retries=2, subdir="b")
+
+    def test_corrupt_latest_falls_back_without_burning_retries(self, tmp_path):
+        """ISSUE 9 satellite: a torn/bit-flipped LATEST checkpoint at
+        restore time is handled inside Checkpointer.restore (walk back one
+        step, replay), never surfaced as another failure against the retry
+        budget — max_retries=1 survives crash + corrupt latest."""
+        import dataclasses
+
+        from tpu_compressed_dp.utils.checkpoint import Checkpointer
+
+        def flip(directory, step):
+            step_dir = str(tmp_path / "ck" / str(step))
+            target, size = None, -1
+            for root, _, names in os.walk(step_dir):
+                for name in names:
+                    fp = os.path.join(root, name)
+                    if os.path.getsize(fp) > size:
+                        target, size = fp, os.path.getsize(fp)
+            with open(target, "r+b") as f:
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0xFF]))
+
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        state = self._tiny_state()
+        calls = []
+        tripped = {"done": False}
+
+        def epoch_fn(state, epoch):
+            calls.append(epoch)
+            if epoch == 2 and not tripped["done"]:
+                tripped["done"] = True
+                flip(ckpt.directory, 2)  # corrupt the newest save (step 2)
+                raise RuntimeError("device loss over a torn write")
+            state = dataclasses.replace(state, step=state.step + 1)
+            ckpt.save(state, {"epoch": epoch})
+            return state
+
+        final, info = resilience.run_with_recovery(
+            epoch_fn, state, epochs=4, checkpointer=ckpt, max_retries=1)
+        # restore walked back to step 1 (epoch 0) and replayed epochs 1..3
+        assert calls == [0, 1, 2, 1, 2, 3]
+        assert info["restores"] == 1
+        assert int(final.step) == 4
+        assert ckpt.metrics()["ckpt/rollback_steps"] == 1.0
+        ckpt.close()
+
+
+class TestPreemption:
+    def test_sigterm_sets_flag_and_check_raises(self):
+        h = resilience.PreemptionHandler(log=lambda s: None).install()
+        assert h.installed
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 5.0
+            while not h.triggered and time.time() < deadline:
+                time.sleep(0.001)
+            assert h.triggered
+            with pytest.raises(resilience.Preempted) as ei:
+                h.check(7)
+            assert ei.value.step == 7
+            assert ei.value.signum == signal.SIGTERM
+            # the flag is sticky: every later step boundary raises too
+            with pytest.raises(resilience.Preempted):
+                h.check(8)
+        finally:
+            h.uninstall()
+
+    def test_uninstall_restores_previous_handlers(self):
+        prev_term = signal.getsignal(signal.SIGTERM)
+        prev_int = signal.getsignal(signal.SIGINT)
+        h = resilience.PreemptionHandler(log=lambda s: None).install()
+        assert signal.getsignal(signal.SIGTERM) == h._on_signal
+        assert signal.getsignal(signal.SIGINT) == h._on_signal
+        h.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+        assert signal.getsignal(signal.SIGINT) == prev_int
+        assert not h.installed
+        h.uninstall()  # idempotent
+
+    def test_off_main_thread_degrades_to_inert(self):
+        """signal.signal only works on the main thread; a harness driven
+        from a worker thread gets an inert handler, not a crash."""
+        prev_term = signal.getsignal(signal.SIGTERM)
+        out = {}
+
+        def worker():
+            h = resilience.PreemptionHandler(log=lambda s: None).install()
+            out["installed"] = h.installed
+            h.check(1)      # never raises: no signal can reach the flag
+            h.uninstall()   # no-op, must not touch the main thread's handlers
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert out["installed"] is False
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+
+    def test_run_with_recovery_reraises_preempted(self):
+        """Preemption must reach the harness's emergency-save path, not the
+        restore-and-replay budget — no restore, no retry."""
+        calls = []
+
+        class NeverRestore:
+            def restore(self, state, step=None):
+                raise AssertionError(
+                    "preemption must not trigger a restore")
+
+        def epoch_fn(state, epoch):
+            calls.append(epoch)
+            raise resilience.Preempted("preempted", step=3,
+                                       signum=signal.SIGTERM)
+
+        with pytest.raises(resilience.Preempted):
+            resilience.run_with_recovery(
+                epoch_fn, object(), epochs=3, checkpointer=NeverRestore(),
+                max_retries=5)
+        assert calls == [0]
+
+
+class TestCheckpointStaleCheck:
+    def test_ckpt_age_adds_heartbeat_age(self):
+        """The watchdog's --max_ckpt_age check: the payload's ckpt_age_s was
+        computed at heartbeat-write time, so the heartbeat's own age is
+        added — a dying writer cannot freeze the checkpoint clock."""
+        now = 1000.0
+        hb = {"ts": now - 10.0, "step": 5, "last_ckpt_step": 4,
+              "ckpt_age_s": 100.0}
+        assert resilience.check_heartbeat(
+            "x", max_age_s=60, max_ckpt_age_s=200.0, now=now, hb=hb) == []
+        # 100 (payload) + 10 (heartbeat age) = 110 > 105, though the payload
+        # value alone would pass
+        probs = resilience.check_heartbeat(
+            "x", max_age_s=60, max_ckpt_age_s=105.0, now=now, hb=hb)
+        assert len(probs) == 1 and "checkpoint stale" in probs[0]
+        assert "last_ckpt_step=4" in probs[0]
+        # absent field (checkpointing off) skips the check, not fails it
+        hb2 = {"ts": now, "step": 5}
+        assert resilience.check_heartbeat(
+            "x", max_age_s=60, max_ckpt_age_s=1.0, now=now, hb=hb2) == []

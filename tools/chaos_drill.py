@@ -27,6 +27,18 @@ What it proves (the ISSUE 3 acceptance criteria, each as a named drill):
     identical to the uncrashed run — chaos is step-counter driven, so the
     replay reproduces the same faults.
 
+Checkpoint drills (the ISSUE 9 acceptance rows — utils/checkpoint.py):
+
+  * ``ckpt_preempt`` — ``crash=preempt`` delivers a REAL self-SIGTERM at
+    step N; the loop drains the in-flight async save, cuts an emergency
+    checkpoint and the relaunched run resumes to a final state bitwise
+    identical to the uninterrupted one (an in-graph NaN injection landing
+    after the preemption point proves the replay lines up).
+  * ``ckpt_corrupt`` — a flipped payload byte in the latest checkpoint is
+    caught by the manifest digest; restore walks back to the previous
+    verifiable step (``ckpt/rollback_steps`` + ``ckpt_rollback`` event)
+    instead of raising.
+
 Elastic drills (the ISSUE 7 acceptance row — train/elastic.py):
 
   * ``elastic_gossip`` — heartbeat-directory failure detection: a silent
@@ -154,6 +166,36 @@ def _assert_bitwise(a, b, what):
     for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         assert np.array_equal(np.asarray(la), np.asarray(lb)), (
             f"{what}: leaf not bitwise equal")
+
+
+class _Recorder:
+    """Minimal EventStream stand-in: records (kind, fields) in memory."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+def _flip_byte_in_step(directory, step) -> str:
+    """Flip one byte in the middle of the step's largest payload file —
+    size-preserving, so only the manifest digest can catch it."""
+    sdir = os.path.join(directory, str(step))
+    target, size = None, -1
+    for root, _, files in os.walk(sdir):
+        for f in files:
+            p = os.path.join(root, f)
+            s = os.path.getsize(p)
+            if s > size:
+                target, size = p, s
+    assert target is not None and size > 0, f"nothing to corrupt in {sdir}"
+    with open(target, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return target
 
 
 # ------------------------------------------------------------------ drills
@@ -366,6 +408,129 @@ def drill_crash_recovery(mesh, *, crash_at_step=5, chaos_spec=None) -> Dict:
         assert np.array_equal(np.asarray(getattr(clean.guard, f)),
                               np.asarray(getattr(crashed.guard, f))), f
     return {"restores": info["restores"]}
+
+
+def drill_ckpt_preempt(mesh, *, preempt_at_step=3, n_steps=6) -> Dict:
+    """``crash=preempt`` (a real self-SIGTERM) mid-run => the loop cuts an
+    emergency checkpoint (draining the in-flight async save first) and the
+    relaunched run resumes to a final state bitwise identical to the
+    uninterrupted one — including an in-graph NaN injection landing AFTER
+    the preemption point, proving the replay lines up step-for-step."""
+    import time
+
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.guard import GuardConfig
+    from tpu_compressed_dp.utils.chaos import ChaosConfig, CrashInjector
+    from tpu_compressed_dp.utils.checkpoint import Checkpointer
+    from tpu_compressed_dp.utils.resilience import (Preempted,
+                                                    PreemptionHandler)
+
+    comp = CompressionConfig(method="powersgd", rank=2, error_feedback=True)
+    gcfg = GuardConfig(loss_scaling=False)
+    # NaN at step 4 — AFTER the preempt at 3 — fires only in the resumed
+    # half, so a misaligned replay cannot pass the bitwise check
+    chaos = ChaosConfig(kind="nan", target="grads", steps=(4,), worker=2,
+                        crash_at_step=preempt_at_step, crash_mode="preempt")
+    batches = [_batch(seed=s) for s in range(n_steps)]
+
+    clean, step = _tiny_setup(mesh, comp, gcfg, chaos)
+    for i in range(n_steps):
+        clean, _ = step(clean, batches[i])
+
+    with tempfile.TemporaryDirectory() as td:
+        state, step = _tiny_setup(mesh, comp, gcfg, chaos)
+        ckpt = Checkpointer(td)
+        crash = CrashInjector(chaos.crash_at_step, mode=chaos.crash_mode)
+        handler = PreemptionHandler(log=lambda s: None).install()
+        assert handler.installed, "drill must run on the main thread"
+        preempted_at = None
+        try:
+            i = 0
+            while i < n_steps:
+                crash.check(i)          # preempt mode: self-SIGTERM, no raise
+                if crash.fired and not handler.triggered:
+                    # the signal lands within a few bytecodes; wait it out
+                    # deterministically rather than racing the handler
+                    for _ in range(1000):
+                        if handler.triggered:
+                            break
+                        time.sleep(0.001)
+                handler.check(i)
+                state, _ = step(state, batches[i])
+                i += 1
+                if i % 2 == 0:
+                    ckpt.save_async(state, {"step_i": i})
+            raise AssertionError("preempt never fired")
+        except Preempted as err:
+            preempted_at = err.step
+            # the emergency-save path: drain the in-flight async write,
+            # then cut the final checkpoint synchronously
+            ckpt.drain(raise_error=False)
+            ckpt.save(state, {"step_i": i, "emergency": True})
+            ckpt.close()
+        finally:
+            handler.uninstall()
+        assert preempted_at == preempt_at_step, preempted_at
+
+        # "relaunch": fresh process state, restore, run the remaining steps
+        state2, step2 = _tiny_setup(mesh, comp, gcfg, chaos)
+        ckpt2 = Checkpointer(td)
+        state2, meta = ckpt2.restore(state2)
+        ckpt2.close()
+        state2 = state2.with_mesh_sharding(mesh)
+        assert meta.get("emergency") is True, meta
+        i = int(meta["step_i"])
+        assert i == preempt_at_step, (i, meta)
+        while i < n_steps:
+            state2, _ = step2(state2, batches[i])
+            i += 1
+
+    _assert_bitwise(_snap(clean), _snap(state2), "ckpt_preempt state")
+    assert int(clean.step) == int(state2.step) == n_steps
+    for f in ("loss_scale", "skips", "total_skipped", "last_good_step"):
+        assert np.array_equal(np.asarray(getattr(clean.guard, f)),
+                              np.asarray(getattr(state2.guard, f))), f
+    return {"preempted_at": preempted_at, "resumed_from": preempt_at_step,
+            "bitwise": True}
+
+
+def drill_ckpt_corrupt(mesh, *, n_steps=4) -> Dict:
+    """A corrupted latest checkpoint (one flipped payload byte — the
+    manifest digest is the only thing that can notice) => restore walks
+    back to the newest verifiable step instead of raising, records
+    ``ckpt/rollback_steps`` and emits a ``ckpt_rollback`` event."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.guard import GuardConfig
+    from tpu_compressed_dp.utils.checkpoint import Checkpointer
+
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True)
+    gcfg = GuardConfig(loss_scaling=False)
+    state, step = _tiny_setup(mesh, comp, gcfg, None)
+    batch = _batch()
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = Checkpointer(td)
+        snaps = {}
+        for i in range(n_steps):
+            state, _ = step(state, batch)
+            ckpt.save(state, {"step_i": i + 1})
+            snaps[int(state.step)] = _snap(state)
+        ckpt.close()
+
+        _flip_byte_in_step(td, n_steps)   # newest step, now torn
+
+        fresh, _ = _tiny_setup(mesh, comp, gcfg, None)
+        ckpt2 = Checkpointer(td)
+        ckpt2.events = _Recorder()
+        restored, meta = ckpt2.restore(fresh)
+        assert int(restored.step) == n_steps - 1, int(restored.step)
+        assert int(meta["step_i"]) == n_steps - 1, meta
+        _assert_bitwise(snaps[n_steps - 1], _snap(restored),
+                        "ckpt_corrupt fallback state")
+        assert ckpt2.metrics()["ckpt/rollback_steps"] == 1.0
+        kinds = [k for k, _ in ckpt2.events.events]
+        assert "ckpt_rollback" in kinds, kinds
+        ckpt2.close()
+    return {"rollback_steps": 1, "restored_step": n_steps - 1}
 
 
 # ----------------------------------------------------------- elastic drills
@@ -582,7 +747,7 @@ def drill_elastic_cascade(mesh) -> Dict:
 # -------------------------------------------------------------------- main
 
 QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery",
-         "elastic_gossip", "elastic_remesh"]
+         "elastic_gossip", "elastic_remesh", "ckpt_preempt", "ckpt_corrupt"]
 FULL = QUICK + ["comp_hold", "ef_identity", "poison_control",
                 "skip_matrix", "ef_identity_sharded",
                 "elastic_readmit", "elastic_cascade", "elastic_matrix"]
@@ -655,7 +820,7 @@ def main(argv=None) -> int:
     p.add_argument("--quick", action="store_true",
                    help="tier-1 smoke subset (skip_consistency, loss_scale, "
                         "max_skips, crash_recovery, elastic_gossip, "
-                        "elastic_remesh)")
+                        "elastic_remesh, ckpt_preempt, ckpt_corrupt)")
     p.add_argument("--drill", action="append", default=None,
                    help="run only the named drill(s)")
     p.add_argument("--list", action="store_true",

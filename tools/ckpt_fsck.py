@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Offline checkpoint fsck: verify / list / prune a checkpoint directory.
+
+Every checkpoint the :class:`~tpu_compressed_dp.utils.checkpoint.Checkpointer`
+commits carries a checksummed manifest (``manifest-<step>.json`` next to the
+step directory: per-file sha256 + byte counts, schema-versioned, committed
+atomically AFTER the Orbax write).  This tool re-verifies those digests
+offline — before resuming a long run on a directory that survived a
+preemption, or from cron over a fleet's checkpoint trees:
+
+  * default — verify every step; print OK / CORRUPT per step (legacy steps
+    without a manifest are tolerated and flagged), plus orphaned manifests
+    whose step directory is gone.  Exit 0 = all verifiable, 1 = something
+    is corrupt, 2 = the directory is missing/empty.
+  * ``--list`` — one line per step with its manifest summary (file count,
+    payload bytes, meta keys), no verification.  Exit 0.
+  * ``--prune`` — delete corrupt step directories and their manifests (and
+    orphaned manifests), leaving only steps a restore can actually use.
+    Exit 0 after pruning.
+
+Pure host-side file I/O — no JAX or Orbax import, safe to run anywhere::
+
+    python tools/ckpt_fsck.py /ckpts/run17
+    python tools/ckpt_fsck.py /ckpts/run17 --list
+    python tools/ckpt_fsck.py /ckpts/run17 --prune
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+from typing import List, Optional
+
+from tpu_compressed_dp.utils.checkpoint import (list_step_dirs, manifest_path,
+                                                read_manifest, verify_step_dir)
+
+
+def _orphan_manifests(directory: str, steps: List[int]) -> List[str]:
+    """manifest-<step>.json files whose step directory no longer exists
+    (a crash between Orbax's delete and the manifest cleanup)."""
+    have = {str(s) for s in steps}
+    out = []
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("manifest-") and name.endswith(".json")):
+            continue
+        step = name[len("manifest-"):-len(".json")]
+        if step.isdigit() and step not in have:
+            out.append(os.path.join(directory, name))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("dir", help="checkpoint directory (harness --checkpoint_dir)")
+    p.add_argument("--list", action="store_true",
+                   help="list steps + manifest summaries, no verification")
+    p.add_argument("--prune", action="store_true",
+                   help="delete corrupt step dirs + orphaned manifests")
+    args = p.parse_args(argv)
+
+    if not os.path.isdir(args.dir):
+        print(f"ckpt_fsck: no such directory: {args.dir}")
+        return 2
+    steps = list_step_dirs(args.dir)
+    if not steps:
+        print(f"ckpt_fsck: no checkpoints under {args.dir}")
+        return 2
+
+    if args.list:
+        for s in steps:
+            man = read_manifest(args.dir, s)
+            if man is None:
+                print(f"step {s}: (no manifest — legacy checkpoint)")
+                continue
+            files = man.get("files", {}) or {}
+            total = sum(int(e.get("bytes", 0)) for e in files.values())
+            meta_keys = ",".join(sorted((man.get("meta") or {}).keys())) or "-"
+            print(f"step {s}: {len(files)} files, {total} bytes, "
+                  f"meta[{meta_keys}]")
+        return 0
+
+    bad: List[int] = []
+    for s in steps:
+        problems = verify_step_dir(args.dir, s)
+        if problems:
+            bad.append(s)
+            for pr in problems:
+                print(f"step {s}: CORRUPT: {pr}")
+        elif read_manifest(args.dir, s) is None and not os.path.exists(
+                manifest_path(args.dir, s)):
+            print(f"step {s}: OK (legacy, no manifest)")
+        else:
+            print(f"step {s}: OK")
+    orphans = _orphan_manifests(args.dir, steps)
+    for o in orphans:
+        print(f"orphaned manifest: {o}")
+
+    if args.prune:
+        for s in bad:
+            shutil.rmtree(os.path.join(args.dir, str(s)), ignore_errors=True)
+            try:
+                os.remove(manifest_path(args.dir, s))
+            except OSError:
+                pass
+            print(f"pruned step {s}")
+        for o in orphans:
+            try:
+                os.remove(o)
+                print(f"pruned {o}")
+            except OSError:
+                pass
+        return 0
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

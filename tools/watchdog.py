@@ -20,6 +20,9 @@ Checks (see :func:`tpu_compressed_dp.utils.resilience.check_heartbeat`):
     cannot see; pair with ``--guard``).
   * **stalled** — telemetry ``steps_per_sec`` below ``--min_step_rate``:
     alive and applying updates, but crawling.
+  * **checkpoint-stale** — heartbeat ``ckpt_age_s`` (plus the heartbeat's
+    own age) exceeds ``--max_ckpt_age``: the run is making progress it
+    could not recover — a crash now loses that much work.
 
 ``--relaunch`` is the acting half: it supervises the training command given
 after ``--``, runs the SAME health check every ``--interval`` seconds
@@ -60,7 +63,8 @@ import sys
 import time
 from typing import Callable, List, Optional
 
-from tpu_compressed_dp.utils.resilience import check_heartbeat, read_heartbeat
+from tpu_compressed_dp.utils.resilience import (PREEMPT_EXIT, check_heartbeat,
+                                                read_heartbeat)
 
 
 def run_check(args) -> int:
@@ -76,6 +80,7 @@ def run_check(args) -> int:
         max_age_s=args.max_age,
         max_wedge_steps=args.max_wedge,
         min_steps_per_sec=args.min_step_rate,
+        max_ckpt_age_s=args.max_ckpt_age,
         hb=hb,
     )
     if problems:
@@ -115,14 +120,19 @@ def supervise(spawn: Callable[[], "subprocess.Popen"],
               sleep: Callable[[float], None] = time.sleep,
               kill: Callable[..., None] = kill_child,
               log: Callable[[str], None] = print,
-              max_checks: Optional[int] = None) -> int:
+              max_checks: Optional[int] = None,
+              preempt_exit_code: Optional[int] = PREEMPT_EXIT) -> int:
     """The relaunch decision loop, with every side effect injectable so the
     unit test can drive it against a fake child and a scripted check
     sequence (tests/test_observability.py::TestWatchdogRelaunch).
 
     Protocol per tick: sleep ``interval_s``; a child that exited cleanly
-    (rc 0) ends supervision with 0; otherwise consult ``check`` (the
-    heartbeat verdict — 0 healthy / 1 unhealthy / 2 missing).  Healthy
+    (rc 0) ends supervision with 0; a child that exited with
+    ``preempt_exit_code`` (the harness's PREEMPT_EXIT after a SIGTERM
+    emergency save) is respawned IMMEDIATELY — no backoff and no burn of
+    the consecutive budget, preemption being the environment's fault, not
+    the run's; otherwise consult ``check`` (the heartbeat verdict — 0
+    healthy / 1 unhealthy / 2 missing).  Healthy
     resets the consecutive-restart counter (and so the backoff).  Unhealthy
     or missing: if the consecutive budget is spent, give up (child's exit
     code, else 1); otherwise kill whatever is left of the child, back off
@@ -142,6 +152,17 @@ def supervise(spawn: Callable[[], "subprocess.Popen"],
             if child.poll() is not None and child.returncode == 0:
                 log("watchdog: child exited cleanly; supervision done")
                 return 0
+            if (child.poll() is not None and preempt_exit_code is not None
+                    and child.returncode == preempt_exit_code):
+                # preemption is not a failure: the child cut an emergency
+                # checkpoint and exited deliberately.  Respawn NOW — no
+                # backoff, no consecutive-budget burn, no health check
+                # consumed (the freed capacity may already be back)
+                log(f"watchdog: child preempted (exit {preempt_exit_code}); "
+                    "relaunching immediately")
+                child = spawn()
+                ticks_since_launch = 0.0
+                continue
             if ticks_since_launch < grace_until:
                 continue  # fresh (re)launch: let the heartbeat appear
             rc = check()
@@ -242,6 +263,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "counter (default: no wedge check)")
     p.add_argument("--min_step_rate", type=float, default=None,
                    help="min telemetry steps/sec (default: no stall check)")
+    p.add_argument("--max_ckpt_age", type=float, default=None,
+                   help="max seconds since the run's last durable "
+                        "checkpoint (heartbeat ckpt_age_s + heartbeat age; "
+                        "default: no checkpoint-staleness check)")
     p.add_argument("--interval", type=float, default=30.0,
                    help="relaunch mode: seconds between health checks")
     p.add_argument("--grace", type=float, default=120.0,

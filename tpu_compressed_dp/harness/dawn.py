@@ -30,11 +30,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_compressed_dp.data import cifar10 as data
-from tpu_compressed_dp.harness.loop import (add_robustness_args,
+from tpu_compressed_dp.harness.loop import (add_checkpoint_args,
+                                            add_robustness_args,
                                             add_telemetry_args,
                                             build_elastic, build_robustness,
                                             elastic_distributed_init,
                                             make_event_stream, make_heartbeat,
+                                            make_preemption, preempt_exit,
                                             profile_trace, train_epoch)
 from tpu_compressed_dp.models import alexnet as alexnet_mod
 from tpu_compressed_dp.models import resnet9 as resnet9_mod
@@ -52,6 +54,7 @@ from tpu_compressed_dp.train.guard import init_guard_state
 from tpu_compressed_dp.train.schedules import piecewise_linear
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import make_eval_step, make_train_step
+from tpu_compressed_dp.utils import resilience
 from tpu_compressed_dp.utils.loggers import TableLogger, TSVLogger
 from tpu_compressed_dp.utils.timer import Timer
 
@@ -204,6 +207,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     # robustness: shared --guard*/--chaos/--heartbeat surface
     add_robustness_args(p, check_note="checked at epoch end")
+    # checkpointing: shared --checkpoint_dir/--resume/--ckpt_every surface
+    add_checkpoint_args(p, cadence_help="epochs between async checkpoint "
+                                        "saves (requires --checkpoint_dir; "
+                                        "0 = emergency/final saves only)")
     # telemetry: shared --events/--prom surface (obs/export.py)
     add_telemetry_args(p)
     p.add_argument("--tensorboard", action="store_true",
@@ -399,6 +406,21 @@ def run(args) -> dict:
 
     eval_step = make_eval_step(apply_fn, mesh)
 
+    from tpu_compressed_dp.utils.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+    start_epoch = 0
+    if args.resume:
+        restorer = Checkpointer(args.resume)
+        try:
+            state, meta = restorer.restore(state)
+        finally:
+            restorer.close()
+        state = state.with_mesh_sharding(mesh)
+        start_epoch = int(meta.get("epoch", -1)) + 1
+        print(f"resumed step {int(state.step)} from {args.resume} "
+              f"(starting epoch {start_epoch})")
+
     # epoch summaries print master-only, like the reference's rank-0-gated
     # loggers (`logger.py:74-121`); metrics are globally reduced so every
     # rank computes identical numbers anyway
@@ -425,6 +447,9 @@ def run(args) -> dict:
         args, harness="dawn", network=args.network,
         method=args.method, compress=args.compress, mode=args.mode,
         transport=args.transport, batch_size=bs, devices=ndev, epochs=epochs)
+    if ckpt is not None:
+        ckpt.events = events
+    preempt = make_preemption()
     el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events)
     if el is not None and rejoin is not None:
         # watchdog-relaunched host: adopt the running world's replicated
@@ -455,8 +480,11 @@ def run(args) -> dict:
     # nor a running profiler trace or an unterminated event stream
     try:
         cur_train, cur_test, cur_bs = train_batches, test_batches, bs
-        epoch = 0
+        epoch = start_epoch
         while epoch < epochs:
+            # boundary check: a signal that landed during eval/logging stops
+            # the run before the next epoch compiles/dispatches anything
+            preempt.check(int(state.step))
             profiling = args.profile_epoch == epoch and args.log_dir
             train_step = train_step_for(ratio_for_epoch(epoch))
             try:
@@ -467,7 +495,7 @@ def run(args) -> dict:
                         timer, cur_bs, test_time_in_total=False,
                         crash=crash, step_offset=int(state.step),
                         guard_cfg=guard_cfg, timeline=timeline, world=ndev,
-                        elastic=el,
+                        elastic=el, preempt=preempt,
                     )
             except Exception as err:
                 failure = el.failure_from(err) if el is not None else None
@@ -504,6 +532,11 @@ def run(args) -> dict:
                     from tpu_compressed_dp.train.elastic import TrimBatches
                     cur_train = TrimBatches(train_batches, cur_bs)
                     cur_test = TrimBatches(test_batches, cur_bs)
+            if (ckpt is not None and args.ckpt_every > 0
+                    and (epoch + 1) % args.ckpt_every == 0):
+                # async: snapshot to host and return — the write overlaps
+                # the next epoch; the next save (or preemption) barriers
+                ckpt.save_async(state, {"epoch": epoch})
             train_time = epoch_stats["train time"]
             examples = len(cur_train) * cur_bs
             thr = flops_mod.throughput_record(
@@ -520,6 +553,7 @@ def run(args) -> dict:
                                     if guard_cfg is not None else int(state.step)),
                     epoch=epoch,
                     telemetry=telemetry_snapshot(timeline),
+                    **(ckpt.heartbeat_fields() if ckpt is not None else {}),
                     **({"elastic": el.metrics()} if el is not None else {}),
                 )
             summary = {
@@ -553,6 +587,7 @@ def run(args) -> dict:
                     {"loss": summary["train loss"], "lr": summary["lr"],
                      **thr, **comm_means, **guard_last,
                      **timeline.snapshot(),
+                     **(ckpt.metrics() if ckpt is not None else {}),
                      **(el.metrics() if el is not None else {})},
                     args.prom, labels={"harness": "dawn"})
             if rank0:
@@ -565,8 +600,19 @@ def run(args) -> dict:
             epoch += 1
         if args.log_dir and rank0:
             tsv.save(args.log_dir)
+    except resilience.Preempted as err:
+        # SIGTERM/SIGINT landed: drain the in-flight async write, cut a
+        # synchronous emergency checkpoint of the live state, and exit with
+        # the watchdog's relaunch-immediately code (the finally below still
+        # runs — ckpt.close after the emergency save is a no-op drain)
+        state = getattr(err, "elastic_state", state)
+        raise preempt_exit(err, ckpt=ckpt, state=state,
+                           meta={"epoch": epoch - 1}, events=events) from None
     finally:
+        preempt.uninstall()
         tb.close()
+        if ckpt is not None:
+            ckpt.close()  # drains the background writer before events close
         if events is not None:
             events.close()
         if hb is not None:

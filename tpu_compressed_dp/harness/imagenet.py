@@ -51,9 +51,11 @@ from tpu_compressed_dp.harness.loop import (
     elastic_distributed_init,
     make_event_stream,
     make_heartbeat,
+    make_preemption,
     comm_summary,
     guard_summary,
     pad_batch,
+    preempt_exit,
     profile_trace,
     run_eval,
     run_train_epoch,
@@ -74,6 +76,7 @@ from tpu_compressed_dp.train.guard import init_guard_state
 from tpu_compressed_dp.train.schedules import phase_lr_schedule_variable_bs
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import make_eval_step, make_train_step
+from tpu_compressed_dp.utils import resilience
 from tpu_compressed_dp.utils.checkpoint import Checkpointer
 from tpu_compressed_dp.utils.loggers import (
     FileLogger,
@@ -434,6 +437,9 @@ def run(args) -> Dict[str, float]:
         args, harness="imagenet", arch=args.arch, method=args.method,
         compress=args.compress, mode=args.mode, transport=args.transport,
         devices=ndev, epochs=epochs)
+    if ckpt is not None:
+        ckpt.events = events   # save/rollback records on the run's stream
+    preempt = make_preemption()
     el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events)
     if el is not None and rejoin is not None:
         # watchdog-relaunched host: the surviving world is mid-training.
@@ -483,10 +489,15 @@ def run(args) -> Dict[str, float]:
 
         epoch = start_epoch
         while epoch < epochs:
+            # a SIGTERM between epochs cuts the emergency save here rather
+            # than after another full epoch of (doomed) work
+            preempt.check(int(state.step))
             swapped = pd.set_epoch(epoch)
             if swapped and ckpt and epoch > 0:
-                # phase-boundary save (`train_imagenet_nv.py:251-253`)
-                ckpt.save(state, {"epoch": epoch - 1, "phase_boundary": True})
+                # phase-boundary save (`train_imagenet_nv.py:251-253`);
+                # async — the new phase's jit warmup hides the write
+                ckpt.save_async(state, {"epoch": epoch - 1,
+                                        "phase_boundary": True})
 
             def train_batches():
                 # after a remesh the loader's batch may stop dividing the
@@ -508,7 +519,8 @@ def run(args) -> Dict[str, float]:
                                                  step_offset=int(state.step),
                                                  guard_cfg=guard_cfg,
                                                  timeline=timeline,
-                                                 elastic=el)
+                                                 elastic=el,
+                                                 preempt=preempt)
             except Exception as err:  # noqa: BLE001 - converted or re-raised
                 failure = el.failure_from(err) if el is not None else None
                 if failure is None:
@@ -551,6 +563,7 @@ def run(args) -> Dict[str, float]:
                                     if guard_cfg is not None else int(state.step)),
                     epoch=epoch,
                     telemetry=telemetry_snapshot(timeline),
+                    **(ckpt.heartbeat_fields() if ckpt is not None else {}),
                     **({"elastic": el.metrics()} if el is not None else {}),
                 )
             train_time = timer()
@@ -608,6 +621,7 @@ def run(args) -> Dict[str, float]:
                 write_prometheus(
                     {"loss": summary["train loss"], **thr, **comm_means,
                      **guard_last, **timeline.snapshot(),
+                     **(ckpt.metrics() if ckpt is not None else {}),
                      **(el.metrics() if el is not None else {})},
                     args.prom, labels={"harness": "imagenet"})
             # tensorboard: x-axis = cumulative examples (`logger.py:24-34`);
@@ -641,14 +655,23 @@ def run(args) -> Dict[str, float]:
             epoch += 1
         if args.logdir:
             tsv.save(args.logdir)
+    except resilience.Preempted as err:
+        # SIGTERM/SIGINT landed: cut the emergency checkpoint (draining any
+        # in-flight async write first) and exit PREEMPT_EXIT so the watchdog
+        # relaunches immediately instead of burning its backoff/budget
+        state = getattr(err, "elastic_state", state)
+        raise preempt_exit(err, ckpt=ckpt, state=state,
+                           meta={"epoch": epoch - 1},
+                           events=events) from None
     finally:
+        preempt.uninstall()
         tb.close()
+        if ckpt:
+            ckpt.close()   # drains the background writer before events close
         if events is not None:
             events.close()
         if hb is not None:
             hb.stop()
-        if ckpt:
-            ckpt.close()
     return summary
 
 

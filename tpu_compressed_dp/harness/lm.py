@@ -35,6 +35,7 @@ from tpu_compressed_dp.train.lm_step import (
 from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.schedules import piecewise_linear
 from tpu_compressed_dp.train.state import TrainState
+from tpu_compressed_dp.utils import resilience
 from tpu_compressed_dp.utils.checkpoint import Checkpointer
 from tpu_compressed_dp.utils.loggers import TableLogger
 
@@ -129,6 +130,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log_every", type=int, default=10)
     p.add_argument("--checkpoint_dir", type=str, default=None)
+    p.add_argument("--ckpt_every", type=int, default=0,
+                   help="steps between async checkpoint saves (requires "
+                        "--checkpoint_dir; 0 = final/emergency saves only)")
     p.add_argument("--resume", type=str, default=None)
     p.add_argument("--coordinator", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)
@@ -275,7 +279,9 @@ def run(args) -> Dict[str, float]:
 
     guard_meter = GuardMeter()
     from tpu_compressed_dp.harness.loop import (make_event_stream,
-                                                make_heartbeat, profile_trace)
+                                                make_heartbeat,
+                                                make_preemption,
+                                                preempt_exit, profile_trace)
     from tpu_compressed_dp.obs.export import (telemetry_snapshot,
                                               write_prometheus)
     from tpu_compressed_dp.obs.trace import StepTimeline
@@ -287,6 +293,9 @@ def run(args) -> Dict[str, float]:
         method=comp.method or "none", compress=args.compress, mode=args.mode,
         transport=args.transport, seq_len=args.seq_len,
         global_batch=args.global_batch, steps=args.steps)
+    if ckpt is not None:
+        ckpt.events = events   # save/rollback records on the run's stream
+    preempt = make_preemption()
     if getattr(args, "elastic", False) and pipelined:
         # dp x sp and dp x tp remesh by deleting the dead DATA row (the
         # model shards are replicated across data rows); a pipeline stage
@@ -343,6 +352,9 @@ def run(args) -> Dict[str, float]:
                         profile_trace(os.path.join(args.logdir, "profile")))
                 if crash is not None:
                     crash.check(step_i)
+                # after crash.check: crash=preempt self-SIGTERMs there, and
+                # the flag must be observed within the same iteration
+                preempt.check(step_i)
                 if el is not None:
                     el.poll(step_i)
                 batch = ds.batch(step_i)
@@ -383,6 +395,8 @@ def run(args) -> Dict[str, float]:
                             last_good_step=(int(m["guard/last_good_step"])
                                             if guard_cfg is not None else step_i + 1),
                             telemetry=telemetry_snapshot(timeline),
+                            **(ckpt.heartbeat_fields() if ckpt is not None
+                               else {}),
                             **({"elastic": el.metrics()} if el is not None else {}),
                         )
                     steps_timed = step_i + 1 - timed_from
@@ -450,6 +464,7 @@ def run(args) -> Dict[str, float]:
                             {"loss": summary["loss"], "lr": summary["lr"],
                              **thr, **comm_m, **guard_last,
                              **timeline.snapshot(),
+                             **(ckpt.metrics() if ckpt is not None else {}),
                              **(el.metrics() if el is not None else {})},
                             args.prom, labels={"harness": "lm"})
                     table.append(summary)
@@ -501,17 +516,31 @@ def run(args) -> Dict[str, float]:
                 timed_from = step_i
                 timeline.resume()
                 continue
+            if (ckpt is not None and args.ckpt_every
+                    and (step_i + 1) % args.ckpt_every == 0):
+                # async: snapshot to host, hand the Orbax write to the
+                # background thread, keep stepping
+                ckpt.save_async(state, {"step": step_i + 1})
             step_i += 1
         if ckpt:
             ckpt.save(state, {"step": int(state.step)})
+    except resilience.Preempted as err:
+        # SIGTERM/SIGINT landed: cut the emergency checkpoint (draining any
+        # in-flight async write first) and exit PREEMPT_EXIT so the watchdog
+        # relaunches immediately instead of burning its backoff/budget
+        state = getattr(err, "elastic_state", state)
+        raise preempt_exit(err, ckpt=ckpt, state=state,
+                           meta={"step": int(state.step)},
+                           events=events) from None
     finally:
+        preempt.uninstall()
         prof.close()
+        if ckpt:
+            ckpt.close()   # drains the background writer before events close
         if events is not None:
             events.close()
         if hb is not None:
             hb.stop()
-        if ckpt:
-            ckpt.close()
     return summary
 
 

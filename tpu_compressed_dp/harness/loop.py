@@ -21,8 +21,9 @@ from tpu_compressed_dp.utils.timer import Timer
 
 __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
            "comm_summary", "guard_summary", "add_robustness_args",
-           "add_telemetry_args", "build_robustness", "build_elastic",
-           "elastic_distributed_init", "make_heartbeat", "make_event_stream",
+           "add_telemetry_args", "add_checkpoint_args", "build_robustness",
+           "build_elastic", "elastic_distributed_init", "make_heartbeat",
+           "make_event_stream", "make_preemption", "preempt_exit",
            "profile_trace"]
 
 
@@ -123,6 +124,58 @@ def make_heartbeat(args):
 
     return Heartbeat(args.heartbeat, interval_s=args.heartbeat_interval,
                      payload={"rank": jax.process_index()})
+
+
+def add_checkpoint_args(p, *, cadence_help: str) -> None:
+    """The shared ``--checkpoint_dir`` / ``--resume`` / ``--ckpt_every`` CLI
+    surface (``cadence_help`` names the harness's save cadence unit)."""
+    p.add_argument("--checkpoint_dir", type=str, default=None,
+                   help="Orbax checkpoint directory (async saves, "
+                        "checksummed manifests, preemption emergency saves "
+                        "— utils/checkpoint.py)")
+    p.add_argument("--resume", type=str, default=None,
+                   help="restore the newest verifiable checkpoint from this "
+                        "directory before training")
+    p.add_argument("--ckpt_every", type=int, default=1, help=cadence_help)
+
+
+def make_preemption(log=print):
+    """Install the SIGTERM/SIGINT preemption flag for a harness run.  Always
+    pair with ``handler.uninstall()`` in the run's ``finally``."""
+    from tpu_compressed_dp.utils.resilience import PreemptionHandler
+
+    return PreemptionHandler(log=log).install()
+
+
+def preempt_exit(err, *, ckpt=None, state=None, meta=None, events=None,
+                 log=print):
+    """The harnesses' common preemption epilogue: drain any in-flight async
+    checkpoint write (ignoring its failure — the emergency save is about to
+    supersede it), cut a SYNCHRONOUS emergency checkpoint, emit a
+    ``preempt`` event, and return the ``SystemExit`` carrying
+    :data:`~tpu_compressed_dp.utils.resilience.PREEMPT_EXIT` for the caller
+    to raise — the distinct code ``tools/watchdog.py --relaunch`` respawns
+    immediately on (no backoff burn)."""
+    from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT
+
+    saved = None
+    if ckpt is not None and state is not None:
+        try:
+            ckpt.drain(raise_error=False)
+            saved = ckpt.save(state, {**(meta or {}), "emergency": True})
+        except Exception as save_err:
+            log(f"preempt: emergency checkpoint FAILED: {save_err!r}")
+    if events is not None:
+        try:
+            events.emit("preempt", step=getattr(err, "step", None),
+                        signum=getattr(err, "signum", None), saved_step=saved)
+        except Exception:
+            pass
+    log("preempt: "
+        + (f"emergency checkpoint committed at step {saved}" if saved is not None
+           else "no checkpoint directory — progress since the last save is lost")
+        + f"; exiting {PREEMPT_EXIT} for immediate relaunch")
+    return SystemExit(PREEMPT_EXIT)
 
 
 def build_robustness(args, dtype):
@@ -277,7 +330,7 @@ def pad_batch(batch: Dict[str, np.ndarray], size: int) -> Dict[str, np.ndarray]:
 
 def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
                     *, crash=None, step_offset: int = 0, guard_cfg=None,
-                    timeline=None, elastic=None,
+                    timeline=None, elastic=None, preempt=None,
                     ) -> Tuple[TrainState, MetricAccumulator]:
     # Metrics stay on device until the epoch ends: a per-step float() would
     # block host batch prep on the device and serialize the pipeline (JAX's
@@ -303,6 +356,12 @@ def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
     # deterministic stand-in for a peer dying inside an allreduce), and
     # bounds the epoch-end metrics fetch so a dead peer raises PeerFailed
     # instead of stalling the fetch forever.
+    #
+    # ``preempt`` (utils/resilience.PreemptionHandler) raises Preempted at
+    # the first step boundary after SIGTERM/SIGINT landed; checked AFTER
+    # crash.check so chaos' crash=preempt self-SIGTERM at step N is
+    # observed within the same iteration, and the except below still rides
+    # the live state out for the emergency save.
     acc = MetricAccumulator()
     step_metrics = []
     if timeline is not None:
@@ -315,6 +374,8 @@ def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
                 timeline.batch_ready()
             if crash is not None:
                 crash.check(step_offset + i)
+            if preempt is not None:
+                preempt.check(step_offset + i)
             if elastic is not None:
                 elastic.poll(step_offset + i)
             state, metrics = train_step(state, {k: jnp.asarray(v) for k, v in batch.items()})
@@ -377,6 +438,7 @@ def train_epoch(
     timeline=None,
     world: Optional[int] = None,
     elastic=None,
+    preempt=None,
 ) -> Tuple[TrainState, Dict[str, float], MetricAccumulator]:
     """One train + eval pass with the reference's epoch-summary shape
     (`core.py:324-331`).  ``crash``/``step_offset``/``guard_cfg``/
@@ -389,7 +451,7 @@ def train_epoch(
     state, train_acc = run_train_epoch(
         train_step, state, train_batches, crash=crash,
         step_offset=step_offset, guard_cfg=guard_cfg, timeline=timeline,
-        elastic=elastic)
+        elastic=elastic, preempt=preempt)
     train_time = timer()
     test_stats = run_eval(eval_step, state, test_batches, batch_size)
     test_time = timer(test_time_in_total)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -37,6 +38,12 @@ class EventStream:
     (host epoch seconds); the constructor writes a ``run_start`` record
     with the caller's metadata, ``close()`` a ``run_end``.  Values must be
     JSON-serialisable — pass plain floats, not device arrays.
+
+    Thread-safe: the async checkpointer's background writer emits
+    ``ckpt_save`` records concurrently with the step loop's own events, so
+    write+flush is serialised under a lock and records stay whole-line.  An
+    ``emit`` racing (or after) ``close`` is dropped silently — a late
+    background commit must not crash the run epilogue.
     """
 
     def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None):
@@ -44,21 +51,27 @@ class EventStream:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
         self._f = open(path, "a")
         self._closed = False
         self.emit("run_start", **(meta or {}))
 
     def emit(self, kind: str, **fields: Any) -> None:
         rec = {"v": SCHEMA_VERSION, "kind": kind, "ts": time.time(), **fields}
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        line = json.dumps(rec) + "\n"
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(line)
+            self._f.flush()
 
     def close(self) -> None:
         if self._closed:
             return
         self.emit("run_end")
-        self._f.close()
-        self._closed = True
+        with self._lock:
+            self._closed = True
+            self._f.close()
 
     def __enter__(self) -> "EventStream":
         return self

@@ -174,6 +174,25 @@ declare("elastic/remesh_ms", TIMING, "ms", "max", "host",
         "cumulative training downtime spent in elastic world transitions "
         "(remesh + rendezvous re-init + readmission) over the run")
 
+# --- checkpoint subsystem (utils/checkpoint.py; host-side) --------------
+declare("ckpt/save_ms", TIMING, "ms", "mean", "host",
+        "wall time of the newest committed checkpoint write (Orbax save + "
+        "manifest commit + GC; runs on a background thread for save_async)")
+declare("ckpt/blocked_ms", TIMING, "ms", "max", "host",
+        "cumulative step-loop time spent barriered on an in-flight async "
+        "checkpoint write (a save/drain overlapping the previous one)")
+declare("ckpt/inflight", GAUGE, "writes", "max", "host",
+        "1 while a background checkpoint write is in flight, else 0")
+declare("ckpt/last_step", GAUGE, "steps", "max", "host",
+        "train step of the newest committed checkpoint (-1 before the "
+        "first commit)")
+declare("ckpt/age_s", GAUGE, "s", "max", "host",
+        "seconds since the newest committed checkpoint (since the "
+        "checkpointer opened, before the first commit)")
+declare("ckpt/rollback_steps", COUNTER, "steps", "max", "host",
+        "steps walked back past corrupt/unreadable checkpoints to reach "
+        "the newest verifiable one at restore time")
+
 
 def canonical(key: str) -> str:
     """Map a raw engine stat key to its canonical registry name.

@@ -24,6 +24,7 @@ comma-separated ``key=value`` tokens (a bare ``nan``/``inf`` sets ``kind``):
     --chaos "crash=120"                  # host crash only, no in-graph fault
     --chaos "crash=mid_collective,crash_at_step=12,worker=3"
     --chaos "crash=during_remesh,crash_at_step=12,worker=3"
+    --chaos "crash=preempt,crash_at_step=12"   # self-SIGTERM at step 12
     --chaos "peer_timeout=0.5"           # elastic: tighten gossip staleness
 
 ``crash=mid_collective`` arms the host crash in the **collective phase**:
@@ -36,9 +37,14 @@ phase**: the injector fires while survivors are inside
 ``ElasticRuntime.handle_failure`` — a SECOND worker dying during the
 recovery from the first, the cascading-failure case the runtime must
 re-enter failure handling for (unioned dead set, shrink restarted) rather
-than committing a world that is already stale.  Like every other fault
-here both are keyed off the step counter, so a restored replay reproduces
-them exactly.
+than committing a world that is already stale.  ``crash=preempt`` does not
+raise at all: the injector sends the process a real ``SIGTERM``
+(``os.kill(os.getpid(), ...)``) at the armed step — the deterministic
+stand-in for a spot/preemptible VM reclaim, observed by
+:class:`~tpu_compressed_dp.utils.resilience.PreemptionHandler` and turned
+into the emergency-checkpoint-and-exit path.  Like every other fault here
+all are keyed off the step counter, so a restored replay reproduces them
+exactly.
 
 ``tools/chaos_drill.py`` runs the full injection matrix and asserts the
 guard's invariants.
@@ -47,6 +53,8 @@ guard's invariants.
 from __future__ import annotations
 
 import dataclasses
+import os
+import signal
 from typing import Any, Optional, Tuple
 
 import jax
@@ -86,6 +94,10 @@ class ChaosConfig:
                     handler — a second worker dying while survivors are
                     already remeshing; the runtime unions the dead set and
                     re-enters failure handling)
+                    | 'preempt' (no raise: send this process a real SIGTERM
+                    before dispatching the step — the deterministic spot-VM
+                    reclaim, handled by PreemptionHandler as an emergency
+                    checkpoint + exit)
     peer_timeout:   elastic failure-detection budget in seconds: a peer
                     heartbeat older than this counts as dead, and a blocked
                     device fetch longer than this raises PeerFailed
@@ -109,9 +121,10 @@ class ChaosConfig:
                 f"chaos target must be grads|loss, got {self.target!r}")
         if self.every < 0 or self.worker < 0:
             raise ValueError("chaos every/worker must be >= 0")
-        if self.crash_mode not in ("step", "mid_collective", "during_remesh"):
+        if self.crash_mode not in ("step", "mid_collective", "during_remesh",
+                                   "preempt"):
             raise ValueError("chaos crash_mode must be step|mid_collective|"
-                             f"during_remesh, got {self.crash_mode!r}")
+                             f"during_remesh|preempt, got {self.crash_mode!r}")
         if self.peer_timeout < 0:
             raise ValueError("chaos peer_timeout must be >= 0")
 
@@ -140,7 +153,8 @@ class ChaosConfig:
                 kw["steps"] = tuple(int(s) for s in v.split("+") if s)
             elif k in ("every", "worker"):
                 kw[k] = int(v)
-            elif k == "crash" and v in ("mid_collective", "during_remesh"):
+            elif k == "crash" and v in ("mid_collective", "during_remesh",
+                                        "preempt"):
                 # mode selector rides the crash key; the step itself comes
                 # from a separate crash_at_step=N token
                 kw["crash_mode"] = v
@@ -226,6 +240,16 @@ class CrashInjector:
         self.fired = False
 
     def check(self, step: int, phase: str = "step") -> None:
+        if self.mode == "preempt":
+            # no raise: deliver a REAL SIGTERM to this process, exactly what
+            # a spot-VM reclaim does.  PreemptionHandler's flag (checked by
+            # the loop right after) turns it into the emergency-save path.
+            if (not self.fired and phase == "step"
+                    and self.crash_at_step >= 0
+                    and int(step) >= self.crash_at_step):
+                self.fired = True
+                os.kill(os.getpid(), signal.SIGTERM)
+            return
         # >= not ==: epoch-granular callers (the CNN harnesses check once
         # per batch with the attempted-step counter) must not miss the mark
         # when a skip/resume lands the counter past it

@@ -1,4 +1,4 @@
-"""Orbax checkpoint / resume.
+"""Orbax checkpoint / resume — async, preemption-aware, self-verifying.
 
 The reference checkpoints ``{epoch, state_dict, best_top5, optimizer}`` with
 rank-0 ``torch.save`` when top-5 improves past 93% and at phase boundaries
@@ -7,13 +7,43 @@ rank-0 ``torch.save`` when top-5 improves past 93% and at phase boundaries
 error-feedback residual the reference forgot (SURVEY.md §5) and the PRNG key —
 is one pytree saved atomically through Orbax; under multi-host SPMD Orbax
 writes each shard from its owning host, the role rank-0 gating played.
+
+Three layers on top of the raw Orbax manager:
+
+  * **Async saves** — :meth:`Checkpointer.save_async` snapshots the state to
+    host memory (``jax.device_get``), hands the blocking Orbax write + GC to
+    a background thread, and returns to the step loop.  Any subsequent save
+    / restore / ``close`` barriers on the in-flight write first; the time the
+    step loop spends blocked in such a barrier accrues to ``ckpt/blocked_ms``
+    while the write itself is ``ckpt/save_ms`` (both in ``metrics()``,
+    declared in :mod:`tpu_compressed_dp.obs.registry`).
+  * **Checksummed manifests** — every committed step gets a
+    ``manifest-<step>.json`` at the directory root (per-file SHA-256 + size +
+    schema version, committed atomically via tmp + ``os.replace`` like
+    ``train/rendezvous.py``), so a torn or bit-flipped checkpoint is
+    *detectable* offline (``tools/ckpt_fsck.py``) and at restore time.
+  * **Last-known-good fallback** — :meth:`Checkpointer.restore` with no
+    explicit step walks the chain newest → oldest, skipping steps that fail
+    manifest verification or raise during the Orbax read, and restores the
+    newest verifiable one; the walk-back distance accrues to
+    ``ckpt/rollback_steps`` and emits a ``ckpt_rollback`` event.  Only when
+    *no* step restores does the first error propagate.
+
+Steps are garbage-collected by the Checkpointer itself (newest
+``max_to_keep``), never evicting the pinned ``save_if_best`` step — the raw
+Orbax ``max_to_keep`` would happily delete the best checkpoint after three
+later periodic saves.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -21,45 +51,222 @@ import orbax.checkpoint as ocp
 
 from tpu_compressed_dp.train.state import TrainState
 
-__all__ = ["Checkpointer", "save_checkpoint", "restore_checkpoint"]
+__all__ = [
+    "Checkpointer", "CheckpointCorrupt", "save_checkpoint",
+    "restore_checkpoint", "MANIFEST_SCHEMA", "manifest_path", "read_manifest",
+    "write_manifest", "verify_step_dir", "list_step_dirs",
+]
+
+#: manifest schema version; bump on incompatible manifest layout changes
+MANIFEST_SCHEMA = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint step failed manifest verification (missing files, size
+    or digest mismatch, unreadable manifest)."""
+
+
+# --------------------------------------------------------------------------
+# Manifest helpers — module-level and Orbax-free so ``tools/ckpt_fsck.py``
+# can verify/list/prune a directory offline without constructing a manager.
+
+def manifest_path(directory: str, step: int) -> str:
+    """``manifest-<step>.json`` lives at the directory ROOT: Orbax owns the
+    step directory's contents (and deletes it wholesale), the manifest is
+    ours and must survive to flag a half-deleted step."""
+    return os.path.join(directory, f"manifest-{int(step)}.json")
+
+
+def _digest_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_manifest(directory: str, step: int,
+                   meta: Optional[Dict[str, Any]] = None) -> str:
+    """Hash every file under ``<directory>/<step>`` and commit the manifest
+    atomically (tmp + ``os.replace``, the ``train/rendezvous.py`` idiom).
+    Call only after the Orbax write has finished — the manifest IS the
+    commit marker for the integrity layer."""
+    step = int(step)
+    step_dir = os.path.join(directory, str(step))
+    files: Dict[str, Dict[str, Any]] = {}
+    for root, _, names in os.walk(step_dir):
+        for name in sorted(names):
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, step_dir)
+            files[rel] = {"sha256": _digest_file(fp),
+                          "bytes": os.path.getsize(fp)}
+    rec = {"v": MANIFEST_SCHEMA, "step": step, "ts": time.time(),
+           "files": files, "meta": dict(meta or {})}
+    path = manifest_path(directory, step)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(directory: str, step: int) -> Optional[Dict[str, Any]]:
+    """Parse a step's manifest; ``None`` when missing or unreadable."""
+    try:
+        with open(manifest_path(directory, step), "rb") as f:
+            rec = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def verify_step_dir(directory: str, step: int) -> List[str]:
+    """Verify one step against its manifest; returns problem strings
+    (empty = verifiable).
+
+    A step with *no* manifest at all is tolerated as a legacy (pre-manifest)
+    checkpoint — restore must keep working on directories written by older
+    builds; ``ckpt_fsck --list`` surfaces them as legacy.  A manifest that
+    exists but cannot be parsed IS a problem (a torn manifest commit)."""
+    step = int(step)
+    step_dir = os.path.join(directory, str(step))
+    if not os.path.isdir(step_dir):
+        return [f"step directory missing: {step_dir}"]
+    man = read_manifest(directory, step)
+    if man is None:
+        if os.path.exists(manifest_path(directory, step)):
+            return ["manifest unreadable (torn commit?)"]
+        return []  # legacy checkpoint: no manifest was ever written
+    if man.get("v") != MANIFEST_SCHEMA:
+        return [f"manifest schema {man.get('v')!r} != {MANIFEST_SCHEMA}"]
+    problems = []
+    for rel, ent in (man.get("files") or {}).items():
+        fp = os.path.join(step_dir, rel)
+        if not os.path.isfile(fp):
+            problems.append(f"missing file: {rel}")
+        elif os.path.getsize(fp) != int(ent.get("bytes", -1)):
+            problems.append(
+                f"size mismatch: {rel} ({os.path.getsize(fp)} != "
+                f"{ent.get('bytes')})")
+        elif _digest_file(fp) != ent.get("sha256"):
+            problems.append(f"digest mismatch: {rel}")
+    return problems
+
+
+def list_step_dirs(directory: str) -> List[int]:
+    """Step indices present on disk (numeric subdirectories), sorted."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(int(n) for n in names
+                  if n.isdigit() and os.path.isdir(os.path.join(directory, n)))
 
 
 class Checkpointer:
-    """Step-indexed checkpoint directory with best-metric gating.
+    """Step-indexed checkpoint directory with best-metric gating, async
+    writes, checksummed manifests, and walk-back restore.
 
-    ``save(state, meta)`` always writes; ``save_if_best(state, top5, ...)``
-    reproduces the reference's improve-only policy (`train_imagenet_nv.py:245-250`)
-    minus its ``>93%`` floor (configurable) so small runs checkpoint too.
+    ``save(state, meta)`` always writes (synchronously); ``save_async``
+    returns once the state is snapshotted to host; ``save_if_best(state,
+    top5, ...)`` reproduces the reference's improve-only policy
+    (`train_imagenet_nv.py:245-250`) minus its ``>93%`` floor (configurable)
+    so small runs checkpoint too — and *pins* the best step against GC.
+
+    Not multi-writer safe: one Checkpointer owns a directory.  Internally it
+    IS thread-safe — the background writer and the step loop serialise on an
+    operation lock, and barriers join the writer before any new manager op.
+
+    Set ``.events`` to an :class:`~tpu_compressed_dp.obs.export.EventStream`
+    to get ``ckpt_save`` / ``ckpt_rollback`` records on the ``--events``
+    stream (emission failures never propagate into the save path).
     """
 
-    def __init__(self, directory: str, *, max_to_keep: int = 3):
+    def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
+                 events=None):
         self.directory = os.path.abspath(directory)
+        # GC is ours (best-step pinning); Orbax keeps everything
         self.manager = ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+            options=ocp.CheckpointManagerOptions(max_to_keep=None, create=True),
         )
+        self.max_to_keep = max_to_keep
         self.best_metric: Optional[float] = None
+        #: the pinned step of the best checkpoint; GC never evicts it
+        self.best_step: Optional[int] = None
+        self.events = events
+        #: last background write failure popped by a non-raising barrier
+        self.last_save_error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._bg_error: Optional[BaseException] = None
+        # _op serialises every manager/manifest/GC operation across the step
+        # loop and the background writer; _mx guards the metric counters
+        self._op = threading.RLock()
+        self._mx = threading.Lock()
+        self._inflight = 0
+        self._save_ms = 0.0       # duration of the newest committed write
+        self._blocked_ms = 0.0    # cumulative step-loop time spent in barriers
+        self._rollback_steps = 0  # cumulative restore walk-back distance
+        self._last_step: Optional[int] = None
+        self._mark_mono = time.monotonic()  # newest commit (or open) time
 
-    def save(self, state: TrainState, meta: Optional[Dict[str, Any]] = None) -> int:
+    # ---------------------------------------------------------------- saves
+
+    def save(self, state: TrainState, meta: Optional[Dict[str, Any]] = None
+             ) -> int:
+        """Synchronous save: barrier on any in-flight async write, then block
+        until the Orbax write + manifest commit + GC finish.  This is the
+        emergency-save primitive — when it returns, the step is durable."""
+        self._barrier(accrue=True)
         step = int(state.step)
-        if step in (self.manager.all_steps() or ()):
-            # same train step already on disk (e.g. a phase-boundary save
-            # immediately after resume) — identical state, nothing to write
+        if self._dedupe(step):
             return step
-        meta = dict(meta or {})
-        if self.best_metric is not None:
-            # every save carries best-so-far, so restoring from ANY latest
-            # checkpoint (incl. phase-boundary saves) keeps the improve-only
-            # gate intact
-            meta.setdefault("best_metric", self.best_metric)
-        self.manager.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(_to_saveable(state)),
-                meta=ocp.args.JsonSave(dict(meta or {})),
-            ),
-        )
-        self.manager.wait_until_finished()
+        meta = self._meta_with_best(meta)
+        payload = _to_saveable(state)
+        t0 = time.monotonic()
+        self._write_payload(step, payload, meta)
+        self._committed(step, (time.monotonic() - t0) * 1e3, mode="sync",
+                        meta=meta)
+        return step
+
+    def save_async(self, state: TrainState,
+                   meta: Optional[Dict[str, Any]] = None) -> int:
+        """Hand the write to a background thread and return to the step loop.
+
+        The state is snapshotted to host memory *before* returning (the
+        caller may donate/overwrite the device buffers on the very next
+        step), so the write is consistent no matter what the loop does.  If
+        a previous async write is still in flight this call barriers on it
+        first — that wait is the only blocking and accrues to
+        ``ckpt/blocked_ms``.  A background failure is re-raised at the next
+        barrier (save/save_async/drain); the emergency path uses
+        ``drain(raise_error=False)`` to save what it can anyway.
+        """
+        self._barrier(accrue=True)
+        step = int(state.step)
+        if self._dedupe(step):
+            return step
+        meta = self._meta_with_best(meta)
+        payload = jax.device_get(_to_saveable(state))
+        with self._mx:
+            self._inflight = 1
+
+        def _bg():
+            t0 = time.monotonic()
+            try:
+                self._write_payload(step, payload, meta)
+            except BaseException as e:  # surfaced at the next barrier
+                self._bg_error = e
+            else:
+                self._committed(step, (time.monotonic() - t0) * 1e3,
+                                mode="async", meta=meta)
+            finally:
+                with self._mx:
+                    self._inflight = 0
+
+        self._thread = threading.Thread(
+            target=_bg, name=f"ckpt-save-{step}", daemon=True)
+        self._thread.start()
         return step
 
     def save_if_best(
@@ -67,80 +274,304 @@ class Checkpointer:
         meta: Optional[Dict[str, Any]] = None,
     ) -> bool:
         """Save when ``metric`` (e.g. top-5) beats the best so far and exceeds
-        ``floor`` (the reference gated at 93%, `train_imagenet_nv.py:175,245`)."""
-        if metric < floor or (self.best_metric is not None and metric <= self.best_metric):
+        ``floor`` (the reference gated at 93%, `train_imagenet_nv.py:175,245`).
+        The saved step is pinned: periodic-save GC never evicts it (a new
+        best moves the pin)."""
+        if metric < floor or (self.best_metric is not None
+                              and metric <= self.best_metric):
             return False
         self.best_metric = metric
+        self.best_step = int(state.step)
         self.save(state, {**(meta or {}), "best_metric": metric})
         return True
 
+    def _dedupe(self, step: int) -> bool:
+        """Same train step already on disk AND verifiable (e.g. a
+        phase-boundary save immediately after resume) — identical state,
+        nothing to write.  A step that exists but fails verification is
+        deleted so the re-save (a replay overwriting a torn write) goes
+        through."""
+        if step not in self._steps_on_disk():
+            return False
+        if not verify_step_dir(self.directory, step):
+            return True
+        with self._op:
+            try:
+                self.manager.delete(step)
+            except Exception:
+                pass
+            self._rm_manifest(step)
+        return False
+
+    def _meta_with_best(self, meta: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        # every save carries best-so-far, so restoring from ANY latest
+        # checkpoint (incl. phase-boundary saves) keeps the improve-only
+        # gate — and the GC pin — intact
+        meta = dict(meta or {})
+        if self.best_metric is not None:
+            meta.setdefault("best_metric", self.best_metric)
+        if self.best_step is not None:
+            meta.setdefault("best_step", int(self.best_step))
+        return meta
+
+    def _write_payload(self, step: int, payload: Dict[str, Any],
+                       meta: Dict[str, Any]) -> None:
+        """The blocking write seam for ONE step: Orbax save + manifest commit
+        + GC.  Runs on the caller's thread (sync save) or the background
+        writer (async).  Tests inject a slow/failing replacement here."""
+        with self._op:
+            self.manager.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(payload),
+                    meta=ocp.args.JsonSave(dict(meta)),
+                ),
+            )
+            self.manager.wait_until_finished()
+            write_manifest(self.directory, step, meta=meta)
+            self._gc()
+
+    def _committed(self, step: int, ms: float, *, mode: str,
+                   meta: Dict[str, Any]) -> None:
+        with self._mx:
+            self._save_ms = ms
+            self._last_step = step
+            self._mark_mono = time.monotonic()
+        fields = {"step": step, "ms": round(ms, 3), "mode": mode}
+        if meta.get("emergency"):
+            fields["emergency"] = True
+        self._emit("ckpt_save", **fields)
+
+    def _gc(self) -> None:
+        """Keep the newest ``max_to_keep`` steps plus the pinned best step.
+        Called with ``_op`` held, after each commit."""
+        if not self.max_to_keep or self.max_to_keep <= 0:
+            return
+        steps = sorted(self.manager.all_steps() or ())
+        keep = set(steps[-self.max_to_keep:])
+        if self.best_step is not None:
+            keep.add(int(self.best_step))
+        for s in steps:
+            if s in keep:
+                continue
+            try:
+                self.manager.delete(s)
+            except Exception:
+                continue  # a survivor is harmless; next GC retries
+            self._rm_manifest(s)
+
+    def _rm_manifest(self, step: int) -> None:
+        try:
+            os.remove(manifest_path(self.directory, step))
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- barriers
+
+    def _barrier(self, *, accrue: bool, raise_error: bool = True) -> None:
+        t = self._thread
+        if t is not None:
+            was_alive = t.is_alive()
+            t0 = time.monotonic()
+            t.join()
+            if accrue and was_alive:
+                with self._mx:
+                    self._blocked_ms += (time.monotonic() - t0) * 1e3
+            self._thread = None
+        err, self._bg_error = self._bg_error, None
+        if err is not None:
+            self.last_save_error = err
+            if raise_error:
+                raise err
+
+    def drain(self, *, raise_error: bool = True) -> None:
+        """Block until any in-flight async write commits.  With
+        ``raise_error=False`` (the emergency path) a background failure is
+        recorded in ``last_save_error`` instead of raised, so the caller can
+        still cut its own save."""
+        self._barrier(accrue=True, raise_error=raise_error)
+
+    # -------------------------------------------------------------- restore
+
     def latest_step(self) -> Optional[int]:
-        return self.manager.latest_step()
+        with self._op:
+            return self.manager.latest_step()
+
+    def verify_step(self, step: int) -> List[str]:
+        return verify_step_dir(self.directory, step)
 
     def restore(self, target_state: TrainState, step: Optional[int] = None
                 ) -> Tuple[TrainState, Dict[str, Any]]:
         """Restore into the structure of ``target_state`` (shapes/dtypes/
         shardings come from the target, so a restored run keeps its mesh
-        placement)."""
-        step = step if step is not None else self.manager.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory!r}")
+        placement).
+
+        With an explicit ``step`` the manifest must verify — corruption
+        raises :class:`CheckpointCorrupt` (the caller asked for THAT step).
+        With ``step=None`` the chain is walked newest → oldest past corrupt
+        or unreadable steps to the newest verifiable one; the walk-back
+        accrues to ``ckpt/rollback_steps`` and emits ``ckpt_rollback``.
+        Only when nothing restores does the first error propagate (so a
+        genuine template mismatch on the only checkpoint still surfaces
+        as the original Orbax error)."""
+        # never let a failed *periodic* save block a restore; the failure
+        # stays visible in last_save_error
+        self._barrier(accrue=False, raise_error=False)
         template = _to_saveable(target_state)
-        try:
-            payload = self.manager.restore(
-                step,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(template),
-                    meta=ocp.args.JsonRestore(),
-                ),
-            )
-        except (ValueError, KeyError) as e:
-            # The template can legitimately disagree with the saved tree on
-            # the OPTIONAL state entries: legacy checkpoints lack 'comp'
-            # (pre-PowerSGD) and/or 'guard' (pre-step-guard) entirely, and
-            # toggling powersgd / --guard between save and resume flips
-            # those entries between the empty marker {} and {'on': ...}
-            # (Orbax raises ValueError for template-missing-saved-key and
-            # KeyError for saved-missing-template-key).  Fall back to ONE
-            # template-free restore (saved structure as-is) and let
-            # _from_saveable reconcile guard/comp against the target — but
-            # first verify every OTHER entry matches the template's
-            # structure/shape/dtype exactly, so a genuine mismatch (resized
-            # params, renamed keys) still surfaces as the ORIGINAL error
-            # instead of silently restoring garbage into the caller's tree.
+        if step is not None:
+            problems = verify_step_dir(self.directory, int(step))
+            if problems:
+                raise CheckpointCorrupt(
+                    f"checkpoint step {int(step)} failed verification: "
+                    + "; ".join(problems))
+            payload = self._restore_payload(int(step), template)
+            return self._finish_restore(target_state, payload)
+
+        steps = sorted(self._steps_on_disk(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.directory!r}")
+        newest = steps[0]
+        first_err: Optional[BaseException] = None
+        skipped: List[Dict[str, Any]] = []
+        for s in steps:
+            problems = verify_step_dir(self.directory, s)
+            if problems:
+                if first_err is None:
+                    first_err = CheckpointCorrupt(
+                        f"checkpoint step {s} failed verification: "
+                        + "; ".join(problems))
+                skipped.append({"step": s, "problems": problems})
+                continue
             try:
-                payload = self.manager.restore(
+                payload = self._restore_payload(s, template)
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+                skipped.append({"step": s, "problems": [repr(e)]})
+                continue
+            if s != newest:
+                rollback = newest - s
+                with self._mx:
+                    self._rollback_steps += rollback
+                self._emit("ckpt_rollback", from_step=newest, to_step=s,
+                           rollback_steps=rollback, skipped=skipped)
+            return self._finish_restore(target_state, payload)
+        assert first_err is not None
+        raise first_err
+
+    def _restore_payload(self, step: int, template: Dict[str, Any]
+                         ) -> Dict[str, Any]:
+        with self._op:
+            try:
+                return self.manager.restore(
                     step,
                     args=ocp.args.Composite(
-                        state=ocp.args.StandardRestore(),
+                        state=ocp.args.StandardRestore(template),
                         meta=ocp.args.JsonRestore(),
                     ),
                 )
-            except Exception:
-                raise e
-            saved = payload["state"]
-            if set(saved) - set(template):
-                raise e  # fields this build does not know — not our legacy case
-            for k, tv in template.items():
-                if k in ("guard", "comp"):
-                    continue
-                if k not in saved:
+            except (ValueError, KeyError) as e:
+                # The template can legitimately disagree with the saved tree
+                # on the OPTIONAL state entries: legacy checkpoints lack
+                # 'comp' (pre-PowerSGD) and/or 'guard' (pre-step-guard)
+                # entirely, and toggling powersgd / --guard between save and
+                # resume flips those entries between the empty marker {} and
+                # {'on': ...} (Orbax raises ValueError for
+                # template-missing-saved-key and KeyError for
+                # saved-missing-template-key).  Fall back to ONE
+                # template-free restore (saved structure as-is) and let
+                # _from_saveable reconcile guard/comp against the target —
+                # but first verify every OTHER entry matches the template's
+                # structure/shape/dtype exactly, so a genuine mismatch
+                # (resized params, renamed keys) still surfaces as the
+                # ORIGINAL error instead of silently restoring garbage into
+                # the caller's tree.
+                try:
+                    payload = self.manager.restore(
+                        step,
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardRestore(),
+                            meta=ocp.args.JsonRestore(),
+                        ),
+                    )
+                except Exception:
                     raise e
-                if (jax.tree.structure(tv) != jax.tree.structure(saved[k])):
-                    raise e
-                for tl, sl in zip(jax.tree.leaves(tv),
-                                  jax.tree.leaves(saved[k])):
-                    if (tuple(np.shape(tl)) != tuple(np.shape(sl))
-                            or np.asarray(tl).dtype != np.asarray(sl).dtype):
+                saved = payload["state"]
+                if set(saved) - set(template):
+                    raise e  # fields this build does not know — not legacy
+                for k, tv in template.items():
+                    if k in ("guard", "comp"):
+                        continue
+                    if k not in saved:
                         raise e
+                    if jax.tree.structure(tv) != jax.tree.structure(saved[k]):
+                        raise e
+                    for tl, sl in zip(jax.tree.leaves(tv),
+                                      jax.tree.leaves(saved[k])):
+                        if (tuple(np.shape(tl)) != tuple(np.shape(sl))
+                                or np.asarray(tl).dtype
+                                != np.asarray(sl).dtype):
+                            raise e
+                return payload
+
+    def _finish_restore(self, target_state: TrainState,
+                        payload: Dict[str, Any]
+                        ) -> Tuple[TrainState, Dict[str, Any]]:
         state = _from_saveable(target_state, payload["state"])
         meta = dict(payload.get("meta") or {})
         if "best_metric" in meta:
             self.best_metric = float(meta["best_metric"])
+        if "best_step" in meta:
+            self.best_step = int(meta["best_step"])
         return state, meta
 
+    def _steps_on_disk(self):
+        with self._op:
+            return set(self.manager.all_steps() or ())
+
+    # ---------------------------------------------------------- observability
+
+    def metrics(self) -> Dict[str, float]:
+        """Host-emitter gauges/counters for Prometheus export; keys are
+        declared in ``obs/registry.py``."""
+        with self._mx:
+            return {
+                "ckpt/save_ms": self._save_ms,
+                "ckpt/blocked_ms": self._blocked_ms,
+                "ckpt/inflight": float(self._inflight),
+                "ckpt/last_step": float(
+                    -1 if self._last_step is None else self._last_step),
+                "ckpt/age_s": time.monotonic() - self._mark_mono,
+                "ckpt/rollback_steps": float(self._rollback_steps),
+            }
+
+    def heartbeat_fields(self) -> Dict[str, float]:
+        """The two fields the watchdog's ``--max_ckpt_age`` check reads out
+        of the heartbeat payload."""
+        with self._mx:
+            return {
+                "last_ckpt_step": int(
+                    -1 if self._last_step is None else self._last_step),
+                "ckpt_age_s": time.monotonic() - self._mark_mono,
+            }
+
+    def _emit(self, kind: str, **fields) -> None:
+        ev = self.events
+        if ev is None:
+            return
+        try:
+            ev.emit(kind, **fields)
+        except Exception:
+            pass  # telemetry must never fail a save/restore
+
+    # ----------------------------------------------------------------- close
+
     def close(self):
-        self.manager.close()
+        """Drain the background writer (never raising — close runs in
+        ``finally`` blocks) and close the Orbax manager."""
+        self._barrier(accrue=False, raise_error=False)
+        with self._op:
+            self.manager.close()
 
 
 def _to_saveable(state: TrainState) -> Dict[str, Any]:

@@ -14,18 +14,117 @@ recovery is "relaunch by hand" (`train.py:49`).  Net-new here:
     resets the budget).  With Orbax checkpoints carrying the full
     ``TrainState`` (EF residual and RNG included), a replayed epoch is
     bitwise the run that would have happened without the crash.
+  * ``PreemptionHandler`` — SIGTERM/SIGINT set a step-granularity flag; the
+    harness loops poll it via :meth:`PreemptionHandler.check`, which raises
+    :class:`Preempted` so the harness can drain any in-flight async
+    checkpoint write, cut an emergency save, and exit with
+    :data:`PREEMPT_EXIT` — the code ``tools/watchdog.py --relaunch``
+    respawns immediately on (no backoff, no retry-budget burn).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 __all__ = ["Heartbeat", "read_heartbeat", "is_stale", "check_heartbeat",
-           "run_with_recovery"]
+           "run_with_recovery", "Preempted", "PreemptionHandler",
+           "PREEMPT_EXIT"]
+
+#: exit code of a preempted-and-checkpointed harness (EX_TEMPFAIL: "try
+#: again") — distinct from both clean exit (0) and crash (1), so the
+#: watchdog can relaunch immediately without burning backoff or budget
+PREEMPT_EXIT = 75
+
+
+class Preempted(Exception):
+    """The preemption flag was observed at a step boundary.  An ``Exception``
+    (not ``BaseException``) so ``run_train_epoch``'s handler still attaches
+    the live ``elastic_state`` on the way out — but ``run_with_recovery``
+    re-raises it explicitly: a preemption must trigger the emergency-save
+    path, never a restore-and-replay retry."""
+
+    def __init__(self, msg: str, *, step: Optional[int] = None,
+                 signum: Optional[int] = None):
+        super().__init__(msg)
+        self.step = step
+        self.signum = signum
+
+
+class PreemptionHandler:
+    """Signal-flag bridge between the platform's preemption notice and the
+    step loop.
+
+    >>> handler = PreemptionHandler().install()
+    >>> handler.check(step)     # raises Preempted once SIGTERM/SIGINT landed
+    >>> handler.uninstall()     # ALWAYS, in finally: restore prior handlers
+
+    The Python-level signal handler only sets a :class:`threading.Event` —
+    async-signal-safe, no I/O, no raise from arbitrary bytecode — and the
+    loop converts it to :class:`Preempted` at the next step boundary, so the
+    interrupted state is always a consistent between-steps ``TrainState``.
+
+    ``signal.signal`` only works on the main thread; off it (a harness
+    driven from a test runner's worker thread) ``install`` degrades to an
+    inert handler (``installed`` False, ``check`` never raises) rather than
+    crashing the run.
+    """
+
+    def __init__(self, *, signals=(signal.SIGTERM, signal.SIGINT),
+                 log: Callable[[str], None] = print):
+        self.signals = tuple(signals)
+        self.log = log
+        self.installed = False
+        self._event = threading.Event()
+        self.signum: Optional[int] = None
+        self._prev: Dict[int, Any] = {}
+
+    def install(self) -> "PreemptionHandler":
+        try:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            self.installed = True
+        except ValueError:
+            # not on the main thread: leave the process default in place
+            self._prev.clear()
+            self.installed = False
+        return self
+
+    def _on_signal(self, signum, frame) -> None:
+        self.signum = signum
+        self._event.set()
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def check(self, step: Optional[int] = None) -> None:
+        """Raise :class:`Preempted` if the flag is set (call once per step)."""
+        if self._event.is_set():
+            try:
+                name = signal.Signals(self.signum).name
+            except (ValueError, TypeError):
+                name = str(self.signum)
+            self.log(f"preempt: {name} received; stopping at step {step}")
+            raise Preempted(f"preempted by {name}", step=step,
+                            signum=self.signum)
+
+    def uninstall(self) -> None:
+        """Restore the previous handlers (mandatory in ``finally`` — a leaked
+        handler would swallow the next process's Ctrl-C)."""
+        if not self.installed:
+            return
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, TypeError, OSError):
+                pass
+        self._prev.clear()
+        self.installed = False
 
 
 class Heartbeat:
@@ -132,6 +231,7 @@ def is_stale(path: str, max_age_s: float) -> bool:
 def check_heartbeat(path: str, *, max_age_s: float = 60.0,
                     max_wedge_steps: Optional[int] = None,
                     min_steps_per_sec: Optional[float] = None,
+                    max_ckpt_age_s: Optional[float] = None,
                     now: Optional[float] = None,
                     hb: Optional[Dict[str, Any]] = None) -> list:
     """Health-check a heartbeat file; returns a list of problem strings
@@ -151,9 +251,15 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
       :class:`~tpu_compressed_dp.obs.trace.StepTimeline` window) has
       dropped below ``min_steps_per_sec``: alive, applying updates, but
       crawling (data stall, thrashing input pipeline).
+    * **checkpoint-stale** — ``ckpt_age_s`` (written from
+      ``Checkpointer.heartbeat_fields``) plus the heartbeat's own age
+      exceeds ``max_ckpt_age_s``: training advances but nothing durable is
+      landing — a wedged async writer or a full/readonly checkpoint disk,
+      the failure a crash would silently amplify into lost work.
 
-    Wedge/stall checks are skipped when their payload fields are absent
-    (guard/telemetry off) — absence of optional telemetry is not a fault.
+    Wedge/stall/checkpoint checks are skipped when their payload fields are
+    absent (guard/telemetry/checkpointing off) — absence of optional
+    telemetry is not a fault.
     Pass ``hb`` (an already-parsed record) to check a single consistent
     read — callers that also inspect the payload should read once and
     share it, not race a concurrent ``os.replace`` between two reads.
@@ -183,6 +289,17 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
         problems.append(
             f"stalled: step rate {float(tele['steps_per_sec']):.4g}/s "
             f"below the {min_steps_per_sec:g}/s floor")
+    if max_ckpt_age_s is not None and hb.get("ckpt_age_s") is not None:
+        # the payload's age was computed when the heartbeat was written;
+        # add the heartbeat's own age so a dying writer cannot freeze the
+        # checkpoint clock at a healthy-looking value
+        ckpt_age = float(hb["ckpt_age_s"]) + max(age, 0.0)
+        if ckpt_age > max_ckpt_age_s:
+            problems.append(
+                f"checkpoint stale: last durable save {ckpt_age:.1f}s ago "
+                f"(> {max_ckpt_age_s:g}s, last_ckpt_step="
+                f"{hb.get('last_ckpt_step')}) — a crash now loses that much "
+                "work")
     return problems
 
 
@@ -204,6 +321,12 @@ def run_with_recovery(
     re-run after a restore are derived from the checkpoint meta's ``epoch``
     (saved by the harnesses), falling back to restarting the failed epoch.
     Returns ``(state, {'failures': n, 'restores': m})``.
+
+    :class:`Preempted` is re-raised untouched (the harness's emergency-save
+    path owns it, not the retry budget).  Restore-time *corruption* never
+    consumes a retry either: ``Checkpointer.restore`` walks back to the
+    newest verifiable checkpoint internally, so a torn latest write costs a
+    rollback (accounted in ``ckpt/rollback_steps``), not a failure.
     """
     failures = restores = 0
     epoch = start_epoch
@@ -212,7 +335,7 @@ def run_with_recovery(
             state = epoch_fn(state, epoch)
             failures = 0  # progress resets the retry budget
             epoch += 1
-        except (KeyboardInterrupt, SystemExit):
+        except (KeyboardInterrupt, SystemExit, Preempted):
             raise
         except Exception as train_err:
             failures += 1
