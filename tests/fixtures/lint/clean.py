@@ -1,0 +1,27 @@
+# tcdp-lint: roles=replay,shared_dir
+"""Fixture: near-miss patterns that must produce ZERO findings even with
+every role enabled."""
+import os
+import time
+from typing import Callable
+
+
+def monotonic_ok():
+    # monotonic clocks are replay-safe (durations, not wall time)
+    return time.monotonic()
+
+
+def injected(now: Callable[[], float] = time.time):
+    return now()
+
+
+def atomic(path, payload):
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def reader(path):
+    with open(path) as f:
+        return f.read()

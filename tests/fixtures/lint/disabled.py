@@ -1,0 +1,14 @@
+# tcdp-lint: roles=replay
+"""Fixture: disable-pragma round trip.  The justified disable suppresses its
+finding; the bare disable suppresses but earns a TCDP100."""
+import time
+
+
+def justified(rec):
+    rec["ts"] = time.time()  # tcdp-lint: disable=TCDP101 -- operator-facing log stamp, never replayed
+    return rec
+
+
+def unjustified(rec):
+    rec["ts"] = time.time()  # tcdp-lint: disable=TCDP101
+    return rec

@@ -1,0 +1,21 @@
+# tcdp-lint: roles=replay
+"""Fixture: wall-clock reads in a replay-deterministic module (TCDP101).
+
+One violation per flagged call form; the module-default *reference* at the
+end must NOT fire (injection seams pass)."""
+import time
+from datetime import datetime
+from typing import Callable
+
+
+def stamp_record(rec):
+    rec["ts"] = time.time()  # VIOLATION: direct wall-clock call
+    rec["when"] = datetime.now().isoformat()  # VIOLATION
+    return rec
+
+
+def good_stamp(rec, wall: Callable[[], float] = time.time):
+    # the injection seam: referencing time.time as a default is fine,
+    # calling the injected callable is fine
+    rec["ts"] = wall()
+    return rec

@@ -1,0 +1,21 @@
+# tcdp-lint: roles=shared_dir
+"""Fixture: in-place write to a shared-dir record (TCDP102)."""
+import json
+import os
+
+
+def bad_write(path, rec):
+    with open(path, "w") as f:  # VIOLATION: readers can see a torn record
+        json.dump(rec, f)
+
+
+def good_write(path, rec):
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:  # tmp sibling — passes
+        json.dump(rec, f)
+    os.replace(tmp, path)
+
+
+def good_append(path, line):
+    with open(path, "a") as f:  # append (JSONL event stream) — exempt
+        f.write(line + "\n")

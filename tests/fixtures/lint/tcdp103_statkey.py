@@ -1,0 +1,11 @@
+"""Fixture: stat-key literal not declared in obs/registry.py (TCDP103).
+
+The docstring may mention comm/undeclared_fixture_key without firing.
+"""
+
+
+def emit(stats):
+    stats["comm/undeclared_fixture_key"] = 1.0  # VIOLATION
+    stats["comm/sent_bits"] = 2.0  # declared — passes
+    stats["not_a/family_key"] = 3.0  # unknown family — out of scope
+    return stats

@@ -1,0 +1,24 @@
+"""Fixture: named_scope strings outside the tcdp.<phase> taxonomy (TCDP104)."""
+import jax
+
+from tpu_compressed_dp.obs import trace as obs_trace
+
+
+def bad_scopes(x):
+    with jax.named_scope("my_random_scope"):  # VIOLATION: no tcdp. prefix
+        x = x + 1
+    with jax.named_scope("tcdp.not_a_phase"):  # VIOLATION: unknown phase
+        x = x + 1
+    with obs_trace.phase("not_a_phase"):  # VIOLATION: undeclared phase
+        x = x + 1
+    return x
+
+
+def good_scopes(x):
+    with jax.named_scope("tcdp.compress"):  # declared phase — passes
+        x = x + 1
+    with jax.named_scope("tcdp.chunk3"):  # overlap chunk scope — passes
+        x = x + 1
+    with obs_trace.phase("reduce"):  # declared phase — passes
+        x = x + 1
+    return x

@@ -1,0 +1,29 @@
+"""Fixture: thread-target attribute write without the class lock (TCDP105)."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.last_error = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            try:
+                self.count += 1  # VIOLATION: unguarded write from the thread
+            except Exception as e:
+                with self._lock:
+                    self.last_error = e  # guarded — passes
+
+
+class CleanWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._lock:
+            self.n += 1

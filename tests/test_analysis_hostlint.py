@@ -1,0 +1,93 @@
+"""Unit tests for tcdp-lint pass 2 (tpu_compressed_dp/analysis/hostlint.py).
+
+Each TCDP10x rule must fire on its seeded fixture (tests/fixtures/lint/),
+stay silent on the clean fixture, and honour the disable pragma round trip
+(justified -> suppressed; bare -> suppressed + TCDP100).
+"""
+
+import os
+
+import pytest
+
+from tpu_compressed_dp.analysis.hostlint import lint_source, roles_for_path
+from tpu_compressed_dp.analysis.report import (CODES, filter_suppressed,
+                                               findings_to_json,
+                                               parse_disables)
+
+pytestmark = pytest.mark.quick
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "lint")
+
+
+def _lint_fixture(name):
+    with open(os.path.join(FIXTURES, name), "r", encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, f"tests/fixtures/lint/{name}"), source
+
+
+class TestRulesFire:
+    def test_tcdp101_wallclock(self):
+        findings, _ = _lint_fixture("tcdp101_wallclock.py")
+        assert [f.code for f in findings] == ["TCDP101", "TCDP101"]
+        assert "time.time" in findings[0].message
+
+    def test_tcdp102_nonatomic_write(self):
+        findings, _ = _lint_fixture("tcdp102_nonatomic.py")
+        assert [f.code for f in findings] == ["TCDP102"]
+        assert "os.replace" in findings[0].message
+
+    def test_tcdp103_undeclared_stat_key(self):
+        findings, _ = _lint_fixture("tcdp103_statkey.py")
+        assert [f.code for f in findings] == ["TCDP103"]
+        assert "comm/undeclared_fixture_key" in findings[0].message
+
+    def test_tcdp104_scope_taxonomy(self):
+        findings, _ = _lint_fixture("tcdp104_scope.py")
+        assert [f.code for f in findings] == ["TCDP104"] * 3
+
+    def test_tcdp105_unguarded_thread_write(self):
+        findings, _ = _lint_fixture("tcdp105_thread.py")
+        assert [f.code for f in findings] == ["TCDP105"]
+        assert "self.count" in findings[0].message
+
+
+class TestCleanAndSuppression:
+    def test_clean_fixture_zero_findings(self):
+        findings, _ = _lint_fixture("clean.py")
+        assert findings == []
+
+    def test_disable_round_trip(self):
+        raw, source = _lint_fixture("disabled.py")
+        assert [f.code for f in raw] == ["TCDP101", "TCDP101"]
+        active, suppressed = filter_suppressed(
+            raw, {"tests/fixtures/lint/disabled.py": source})
+        # both wall-clock findings suppressed; the bare pragma earns a
+        # TCDP100 so silent waivers cannot accumulate
+        assert [f.code for f in suppressed] == ["TCDP101", "TCDP101"]
+        assert [f.code for f in active] == ["TCDP100"]
+        assert suppressed[0].justification.startswith("operator-facing")
+
+    def test_parse_disables_forms(self):
+        src = ("x = 1  # tcdp-lint: disable=TCDP101 -- why\n"
+               "# tcdp-lint: disable=TCDP102,TCDP103\n"
+               "y = 2\n")
+        d = parse_disables(src)
+        assert d[1] == (("TCDP101",), "why")
+        # own-line comment guards the following line too
+        assert d[3][0] == ("TCDP102", "TCDP103")
+
+
+class TestDrivers:
+    def test_roles_from_path(self):
+        assert roles_for_path("tpu_compressed_dp/train/rendezvous.py") == {
+            "replay", "shared_dir"}
+        assert roles_for_path("tpu_compressed_dp/parallel/dp.py") == set()
+
+    def test_json_payload_shape(self):
+        findings, _ = _lint_fixture("tcdp103_statkey.py")
+        payload = findings_to_json(findings)
+        assert payload["counts"]["active"] == 1
+        f = payload["active"][0]
+        assert f["code"] == "TCDP103"
+        assert f["description"] == CODES["TCDP103"]

@@ -34,6 +34,33 @@ def test_submodule_imports(module):
     importlib.import_module(module)
 
 
+def _tool_modules():
+    import os
+    tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    return sorted(f"tools.{f[:-3]}" for f in os.listdir(tools_dir)
+                  if f.endswith(".py"))
+
+
+@pytest.mark.quick
+@pytest.mark.imports_smoke
+@pytest.mark.parametrize("module", _tool_modules())
+def test_tool_imports_side_effect_free(module):
+    """Every tool must import without mutating process state (os.environ,
+    sys.path, jax platform config) and expose a ``main`` entry point —
+    the contract that lets tcdp-lint, the test suite, and other tools
+    import them for their helpers without surprise reconfiguration."""
+    import os
+    import sys
+
+    env_before = dict(os.environ)
+    path_before = list(sys.path)
+    mod = importlib.import_module(module)
+    assert dict(os.environ) == env_before, "import mutated os.environ"
+    assert list(sys.path) == path_before, "import mutated sys.path"
+    assert callable(getattr(mod, "main", None)), f"{module} has no main()"
+
+
 @pytest.mark.quick
 @pytest.mark.imports_smoke
 def test_public_surface():

@@ -75,15 +75,16 @@ from __future__ import annotations
 
 import os
 
-# standalone invocation: an 8-device virtual CPU mesh, set up before the
-# first jax import (harmless no-op when imported from the test suite, whose
-# conftest already did this)
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-        + " --xla_backend_optimization_level=0").strip()
+if __name__ == "__main__":
+    # standalone invocation: an 8-device virtual CPU mesh, set up before the
+    # first jax import (importers — the test suite, whose conftest already
+    # did this — get no side effects)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+            + " --xla_backend_optimization_level=0").strip()
 
 import argparse
 import dataclasses

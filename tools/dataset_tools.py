@@ -32,8 +32,11 @@ import shlex
 import subprocess
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from launch_tpu import tpu_ssh_cmd  # noqa: E402 (shared ssh fan-out builder)
+if __package__ in (None, ""):  # script run: tools dir onto sys.path
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from launch_tpu import tpu_ssh_cmd  # noqa: E402 (shared ssh fan-out builder)
+else:
+    from tools.launch_tpu import tpu_ssh_cmd  # noqa: E402
 
 
 def stage_cmd(args) -> list:

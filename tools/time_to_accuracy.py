@@ -45,7 +45,8 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if __package__ in (None, ""):  # script run: repo root onto sys.path
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # (name, bytes/sec per chip).  ICI-class: a v5e-generation inter-chip link
 # (hundreds of GB/s; we take 1.6 Tbps bidirectional ~ 100 GB/s of usable

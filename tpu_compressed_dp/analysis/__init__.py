@@ -1,0 +1,24 @@
+"""Static analysis — tcdp-lint's two passes as an importable subsystem.
+
+Pass 1 (:mod:`tpu_compressed_dp.analysis.spmd`) traces the sync engines and
+step factories to jaxprs and checks the SPMD safety invariants every worker
+relies on structurally: no collective hidden inside divergent control flow,
+deterministic collective signatures across re-traces and worker-symmetric
+configs, donation aliasing that actually lands, and an intact overlap
+chunk chain.  Pass 2 (:mod:`tpu_compressed_dp.analysis.hostlint`) is a
+rule-based ``ast`` walk over the host-side code enforcing the invariants
+the runtime drills (chaos/elastic/rendezvous) depend on: injectable clocks,
+atomic shared-dir writes, registry-declared stat keys, the ``tcdp.<phase>``
+scope taxonomy, and lock-guarded cross-thread mutation.
+
+``tools/tcdp_lint.py`` is the CLI; ``tests/test_lint.py`` gates tier-1 on
+zero unsuppressed findings.  Import of this package must stay jax-free —
+:mod:`.spmd` imports jax lazily so the AST pass runs anywhere.
+"""
+
+from tpu_compressed_dp.analysis.report import (  # noqa: F401
+    CODES, Finding, filter_suppressed, findings_to_json, format_findings,
+)
+
+__all__ = ["CODES", "Finding", "filter_suppressed", "findings_to_json",
+           "format_findings"]

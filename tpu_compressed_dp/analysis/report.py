@@ -1,0 +1,152 @@
+"""Finding record + rule-code table shared by both tcdp-lint passes.
+
+Every rule carries a stable ``TCDPxxx`` code (0xx = jaxpr/SPMD pass, 1xx =
+host AST pass) so suppressions, JSON consumers and the README rule table
+never drift from the implementation: :data:`CODES` IS the table.
+
+Suppression is per-line and must be justified::
+
+    x = time.time()  # tcdp-lint: disable=TCDP101 -- display-only banner ts
+
+The comment may sit on the flagged line or alone on the line above; the
+``-- <why>`` justification is REQUIRED — a bare disable is itself a
+finding (``TCDP100``), so the escape hatch documents the exception instead
+of hiding it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CODES", "Finding", "parse_disables", "filter_suppressed",
+    "format_findings", "findings_to_json",
+]
+
+#: rule code -> one-line description (the README table is generated from
+#: this mapping; tests assert the two stay in sync)
+CODES: Dict[str, str] = {
+    # pass 1 — SPMD / jaxpr analyzer (analysis/spmd.py)
+    "TCDP001": "collective primitive under data-dependent/divergent control "
+               "flow (cond branch asymmetry or data-predicated while)",
+    "TCDP002": "collective signature diverges across re-traces, chunked vs "
+               "single dispatch, or claimed-equivalent engine pairs",
+    "TCDP003": "donated buffer with no shape/dtype-matching output to alias "
+               "(wasted donation -> read-after-donate hazard)",
+    "TCDP004": "overlap chunk plan or optimization_barrier chain broken "
+               "(duplicate group offsets, non-partitioning chunks, "
+               "unchained chunk collectives)",
+    # pass 2 — host-side AST linter (analysis/hostlint.py)
+    "TCDP100": "tcdp-lint disable comment without '-- <justification>'",
+    "TCDP101": "wall-clock read (time.time / datetime.now) in a "
+               "replay-deterministic module — inject a clock instead",
+    "TCDP102": "non-atomic write in a shared-dir protocol module — write a "
+               "*.tmp sibling and os.replace() it",
+    "TCDP103": "stat-key string literal not declared in obs/registry.py",
+    "TCDP104": "named_scope / phase string outside the tcdp.<phase> "
+               "taxonomy",
+    "TCDP105": "attribute mutated from a spawned thread without holding "
+               "the class's lock",
+}
+
+_DISABLE_RE = re.compile(
+    r"#\s*tcdp-lint:\s*disable=(?P<codes>TCDP\d{3}(?:\s*,\s*TCDP\d{3})*)"
+    r"(?:\s*--\s*(?P<why>\S.*))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer hit.  ``file``/``line`` are empty for pass-1 findings
+    raised against a traced config rather than a source location — there
+    ``config`` names the (method, mode, transport, ...) combination."""
+
+    code: str
+    message: str
+    file: str = ""
+    line: int = 0
+    col: int = 0
+    config: str = ""
+    suppressed: bool = False
+    justification: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        d = dataclasses.asdict(self)
+        d["description"] = CODES.get(self.code, "")
+        return d
+
+    def location(self) -> str:
+        if self.file:
+            return f"{self.file}:{self.line}" if self.line else self.file
+        return f"<{self.config}>" if self.config else "<traced>"
+
+
+def parse_disables(source: str) -> Dict[int, Tuple[Tuple[str, ...], str]]:
+    """Map 1-based line number -> (codes, justification) for every line a
+    disable comment covers (its own line, plus the next line when the
+    comment stands alone).  Comments with a missing justification still
+    suppress — the TCDP100 finding they raise is the enforcement."""
+    out: Dict[int, Tuple[Tuple[str, ...], str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        codes = tuple(c.strip() for c in m.group("codes").split(","))
+        why = (m.group("why") or "").strip()
+        out[i] = (codes, why)
+        if text.lstrip().startswith("#"):  # own-line comment guards the next
+            out.setdefault(i + 1, (codes, why))
+    return out
+
+
+def filter_suppressed(findings: Iterable[Finding], source_by_file: Dict[str, str],
+                      ) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (active, suppressed), marking suppressed ones and
+    appending a TCDP100 active finding for each justification-free disable
+    comment that actually suppressed something."""
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    disables_cache: Dict[str, Dict[int, Tuple[Tuple[str, ...], str]]] = {}
+    for f in findings:
+        src = source_by_file.get(f.file)
+        if src is None or not f.line:
+            active.append(f)
+            continue
+        if f.file not in disables_cache:
+            disables_cache[f.file] = parse_disables(src)
+        hit = disables_cache[f.file].get(f.line)
+        if hit is None or f.code not in hit[0]:
+            active.append(f)
+            continue
+        f.suppressed = True
+        f.justification = hit[1]
+        suppressed.append(f)
+        if not hit[1]:
+            active.append(Finding(
+                code="TCDP100", file=f.file, line=f.line,
+                message=f"disable={f.code} has no '-- <justification>'"))
+    return active, suppressed
+
+
+def format_findings(findings: Sequence[Finding], *, color: Optional[bool] = None
+                    ) -> str:
+    lines = []
+    for f in findings:
+        tag = " (suppressed)" if f.suppressed else ""
+        lines.append(f"{f.location()}: {f.code}{tag}: {f.message}")
+    return "\n".join(lines)
+
+
+def findings_to_json(active: Sequence[Finding],
+                     suppressed: Sequence[Finding] = ()) -> Dict[str, object]:
+    """JSON-serialisable report payload (callers ``json.dump`` it)."""
+    return {
+        "version": 1,
+        "active": [f.to_dict() for f in active],
+        "suppressed": [f.to_dict() for f in suppressed],
+        "counts": {
+            "active": len(active),
+            "suppressed": len(suppressed),
+        },
+    }

@@ -147,6 +147,20 @@ declare("throughput/mfu", GAUGE, "ratio", "mean", "host",
         "model FLOPs utilisation vs the chip's bf16 peak")
 declare("net/comm_mb_per_sec", GAUGE, "MB/s", "mean", "host",
         "analytic per-chip gradient-sync link traffic at the measured rate")
+declare("net/payload_mb_per_step", GAUGE, "MB", "mean", "host",
+        "wire payload per step from comm/sent_bits (NetMeter window mean)")
+declare("net/allreduce_gbps_per_chip", GAUGE, "Gb/s", "mean", "host",
+        "per-chip ring-allreduce traffic rate over the NetMeter window")
+declare("net/compression_frac", GAUGE, "ratio", "mean", "host",
+        "wire payload / dense gradient bytes over the NetMeter window")
+declare("net/recv_gbit_s", GAUGE, "Gb/s", "mean", "host",
+        "received Gbit/s at the measured step rate (TB net/ tab parity "
+        "with the reference's in_gb counters)")
+declare("net/transmit_gbit_s", GAUGE, "Gb/s", "mean", "host",
+        "transmitted Gbit/s at the measured step rate")
+declare("guard/skip_rate", GAUGE, "ratio", "mean", "host",
+        "vetoed-step fraction over the logging window "
+        "(windowed mean of guard/nonfinite)")
 declare("time/step_p50_ms", TIMING, "ms", "mean", "host",
         "median host-observed step latency over the timeline window")
 declare("time/step_p95_ms", TIMING, "ms", "mean", "host",

@@ -157,14 +157,18 @@ def heartbeat_path(gossip_dir: str, rank: int) -> str:
 
 def write_peer_heartbeat(gossip_dir: str, rank: int, step: int, *,
                          incarnation: int = 0,
-                         ts: Optional[float] = None) -> str:
+                         ts: Optional[float] = None,
+                         wall: Callable[[], float] = time.time) -> str:
     """One atomic heartbeat write into the gossip directory — the
     thread-free form the harness step loops and the drill's simulated
     peers use (same record shape and atomic tmp+replace as
-    :class:`~tpu_compressed_dp.utils.resilience.Heartbeat`)."""
+    :class:`~tpu_compressed_dp.utils.resilience.Heartbeat`).  ``ts``
+    overrides the record timestamp outright; ``wall`` is the injectable
+    clock it defaults to (peer staleness is judged on LOCAL monotonic
+    freshness, never on this field — see :class:`PeerGossip`)."""
     os.makedirs(gossip_dir, exist_ok=True)
     path = heartbeat_path(gossip_dir, rank)
-    rec = {"ts": time.time() if ts is None else float(ts),
+    rec = {"ts": wall() if ts is None else float(ts),
            "step": int(step), "rank": int(rank),
            "incarnation": int(incarnation)}
     tmp = f"{path}.{os.getpid()}.tmp"

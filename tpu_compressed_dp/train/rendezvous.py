@@ -149,6 +149,7 @@ class Rendezvous:
                  base_port: int = DEFAULT_BASE_PORT,
                  now: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
+                 wall: Callable[[], float] = time.time,
                  poll_s: float = 0.05):
         self.dir = rdzv_dir
         self.rank = int(rank)
@@ -156,6 +157,9 @@ class Rendezvous:
         self.base_port = int(base_port)
         self._now = now
         self._sleep = sleep
+        # informational ts fields on shared-dir records; injectable so a
+        # replayed transition writes byte-identical files (tcdp-lint TCDP101)
+        self._wall = wall
         self.poll_s = float(poll_s)
         os.makedirs(rdzv_dir, exist_ok=True)
 
@@ -179,7 +183,7 @@ class Rendezvous:
         _write_json(self._vote_path(epoch, self.rank), {
             "epoch": int(epoch), "rank": self.rank,
             "survivors": sorted(int(s) for s in survivors),
-            "host": self.host, "ts": time.time()})
+            "host": self.host, "ts": self._wall()})
 
     def read_votes(self, epoch: int) -> Dict[int, dict]:
         votes: Dict[int, dict] = {}
@@ -255,7 +259,7 @@ class Rendezvous:
                     rec = {"epoch": epoch, "ranks": list(members),
                            "coordinator": leader,
                            "address": f"{host}:{self.base_port + epoch}",
-                           "ts": time.time()}
+                           "ts": self._wall()}
                     write_epoch(self.dir, rec)
                     self._gc_votes(epoch)
                     return self.decision_from(rec)
@@ -273,7 +277,7 @@ class Rendezvous:
     def request_join(self, *, incarnation: int = 0) -> None:
         _write_json(self._join_path(self.rank), {
             "rank": self.rank, "incarnation": int(incarnation),
-            "host": self.host, "ts": time.time()})
+            "host": self.host, "ts": self._wall()})
 
     def pending_joins(self) -> Dict[int, dict]:
         """Relaunched hosts waiting for admission (rank -> join record)."""

@@ -256,7 +256,8 @@ class Checkpointer:
             try:
                 self._write_payload(step, payload, meta)
             except BaseException as e:  # surfaced at the next barrier
-                self._bg_error = e
+                with self._mx:
+                    self._bg_error = e
             else:
                 self._committed(step, (time.monotonic() - t0) * 1e3,
                                 mode="async", meta=meta)

@@ -190,7 +190,8 @@ class Heartbeat:
             try:
                 self._write()
             except Exception as e:  # e.g. disk full: record, keep beating
-                self.last_error = e
+                with self._lock:
+                    self.last_error = e
 
     def stop(self) -> None:
         self._stop.set()
