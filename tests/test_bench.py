@@ -67,6 +67,46 @@ def test_projection_method_aware_topk_vs_randomk(mesh8):
     assert 25.0 < ratio < 40.0
 
 
+def test_run_adaptive_point_schema_and_convergence(mesh8):
+    """BENCH_r09 protocol: the closed-loop record carries the per-window
+    trajectory + per-rung static baselines, and with a budget only the
+    bottom rung satisfies the controller must walk down to it."""
+    rec = sweep.run_adaptive_point(
+        method="topk", granularity="entiremodel", ratio=0.5,
+        rungs=(0.5, 0.25), batch_size=64, channels_scale=0.125,
+        windows=3, window=1, budget_ms=20.0, bw_mbps=100.0, devices=8)
+    assert rec["adaptive"] is True and rec["knob"] == "ratio"
+    assert rec["rungs"] == [0.5, 0.25]
+    assert len(rec["window_trace"]) == 3
+    assert len(rec["static_rungs"]) == 2
+    # entiremodel topk @ half-width resnet9: rung 0 bills ~33 ms of modeled
+    # comm at 100 MB/s, rung 1 ~17 ms — only rung 1 fits a 20 ms budget
+    assert [s["fits_budget"] for s in rec["static_rungs"]] == [False, True]
+    assert rec["best_static"] == {"rung": 1, "value": 0.25}
+    assert [t["rung"] for t in rec["window_trace"]] == [0, 1, 1]
+    assert rec["window_trace"][0]["direction"] == "down"
+    assert rec["converged_to_best_static"] is True
+    assert rec["decisions"] == 3
+    # descent billed more than the best-static oracle, but less than rung 0
+    assert (rec["best_static_billed_bits"] < rec["adaptive_billed_bits"]
+            < rec["static_rungs"][0]["bits_per_update"] * rec["updates"])
+
+
+def test_run_sweep_adaptive_cli(mesh8, capsys):
+    args = sweep.build_parser().parse_args([
+        "--model", "resnet9", "--methods", "topk,terngrad",
+        "--ratios", "0.5", "--granularities", "entiremodel",
+        "--batch_size", "64", "--devices", "8", "--channels_scale", "0.125",
+        "--adaptive", "--adaptive_windows", "2", "--adaptive_window", "1",
+        "--adaptive_rungs", "0.5,0.25", "--adaptive_budget_ms", "20.0",
+    ])
+    records = sweep.run_sweep(args)
+    # terngrad has no ladder knob -> skipped with a stderr note, no crash
+    assert [r["method"] for r in records] == ["topk"]
+    assert records[0]["window"] == 1 and records[0]["windows"] == 2
+    assert len(records[0]["window_trace"]) == 2
+
+
 def test_run_sweep_cli(mesh8, tmp_path, capsys):
     args = sweep.build_parser().parse_args([
         "--model", "resnet9", "--methods", "terngrad", "--ratios", "0.01",

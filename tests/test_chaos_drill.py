@@ -34,6 +34,10 @@ def test_quick_drill(mesh8):
     assert results["ckpt_preempt"]["resumed_from"] == 3
     assert results["ckpt_preempt"]["bitwise"] is True
     assert results["ckpt_corrupt"]["rollback_steps"] == 1
+    # ISSUE 11 acceptance row: crash-relaunch mid-decision-window replays
+    # the same rung schedule and the same control_decision events
+    assert results["control_resume"]["rungs"] == [1, 2, 2]
+    assert results["control_resume"]["resumed_mid_window"] is True
 
 
 @pytest.mark.quick

@@ -1,0 +1,431 @@
+"""Closed-loop adaptive compression control plane (tpu_compressed_dp/control/).
+
+The ISSUE 11 acceptance surface: the ladder/config contracts, the decision
+rule, window accounting on the applied-update clock, bitwise decision replay
+through a ControlState serialisation round trip, rung-target recomputation
+through a W-1 elastic remesh, and the dawn harness end to end under
+``--adaptive`` with the event stream parsed back by tools/control_report.py.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_compressed_dp.control import (
+    ControlConfig, Controller, build_ladder, comp_for_rung,
+    control_from_dict, control_to_dict, hideable_budget_ms,
+    init_control_state, ladder_knob, migrate_comp_state, modeled_comm_ms,
+)
+from tpu_compressed_dp.parallel.dp import CompressionConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+# ------------------------------------------------------------ config/ladder
+
+class TestConfigAndLadder:
+    def test_rejects_untunable_method(self):
+        with pytest.raises(ValueError, match="tunes"):
+            ControlConfig(method="qsgd", rungs=(0.5, 0.25))
+
+    def test_rejects_degenerate_ladders(self):
+        with pytest.raises(ValueError, match=">= 2 rungs"):
+            ControlConfig(method="topk", rungs=(0.5,))
+        with pytest.raises(ValueError, match="descend"):
+            ControlConfig(method="topk", rungs=(0.25, 0.5))
+        with pytest.raises(ValueError, match=r"\(0, 1\]"):
+            ControlConfig(method="topk", rungs=(1.5, 0.5))
+        with pytest.raises(ValueError, match="integers"):
+            ControlConfig(method="powersgd", rungs=(2.5, 1.0))
+        with pytest.raises(ValueError, match="window"):
+            ControlConfig(method="topk", rungs=(0.5, 0.25), window=0)
+        with pytest.raises(ValueError, match="deadband"):
+            ControlConfig(method="topk", rungs=(0.5, 0.25), deadband=1.0)
+        with pytest.raises(ValueError, match="signal"):
+            ControlConfig(method="topk", rungs=(0.5, 0.25), signal="psychic")
+        with pytest.raises(ValueError, match="start_rung"):
+            ControlConfig(method="topk", rungs=(0.5, 0.25), start_rung=2)
+
+    def test_default_ladder_anchors_at_static_config(self):
+        # rung 0 == the CLI-configured knob: an adaptive run that never
+        # acts behaves exactly like the static run
+        assert build_ladder("topk", 0.5, 4) == (0.5, 0.25, 0.125, 0.0625,
+                                                0.03125)
+        assert build_ladder("powersgd", 0.5, 8) == (8.0, 4.0, 2.0, 1.0)
+        # the ratio floor: never descend below ~1e-3
+        lo = build_ladder("topk", 0.004, 4)
+        assert lo[0] == 0.004 and min(lo) >= 1e-3
+
+    def test_knob_and_comp_for_rung(self):
+        assert ladder_knob("topk") == "ratio"
+        assert ladder_knob("powersgd") == "rank"
+        with pytest.raises(ValueError, match="knob"):
+            ladder_knob("terngrad")
+        cfg = ControlConfig(method="topk", rungs=(0.5, 0.125))
+        base = CompressionConfig(method="topk", ratio=0.5,
+                                 error_feedback=True)
+        assert comp_for_rung(base, cfg, 1).ratio == 0.125
+        assert comp_for_rung(base, cfg, 1).error_feedback is True
+        rcfg = ControlConfig(method="powersgd", rungs=(4.0, 2.0))
+        rbase = CompressionConfig(method="powersgd", rank=4)
+        assert comp_for_rung(rbase, rcfg, 1).rank == 2
+
+    def test_migrate_comp_state_keeps_warm_columns(self):
+        from tpu_compressed_dp.parallel.dp import init_comp_state
+
+        grads = {"w": jnp.zeros((64, 32), jnp.float32)}
+        old = CompressionConfig(method="powersgd", rank=4)
+        new = CompressionConfig(method="powersgd", rank=2)
+        comp = init_comp_state(grads, old, 4)
+        warm = {k: np.asarray(v) + 1.0 for k, v in comp.items()}
+        migrated = migrate_comp_state(warm, grads, old, new, 4)
+        for k, q in migrated.items():
+            assert q.shape[-1] == 2
+            # the first min(r_old, r_new) columns carry the learnt subspace
+            np.testing.assert_array_equal(np.asarray(q),
+                                          warm[k][..., :2])
+        # stateless / no-op switches pass through untouched
+        assert migrate_comp_state((), grads, old, new, 4) == ()
+        assert migrate_comp_state(warm, grads, old, old, 4) is warm
+
+
+# ------------------------------------------------------------ decision rule
+
+class TestDecisionRule:
+    CFG = ControlConfig(method="topk", rungs=(0.5, 0.25, 0.125),
+                        deadband=0.25, budget_ms=1.0)
+
+    def test_signal_models(self):
+        # 1e6 bits over 100 Mbit/s = 10 ms
+        assert modeled_comm_ms(1e6, 100.0) == pytest.approx(10.0)
+        assert hideable_budget_ms(self.CFG) == 1.0  # pinned
+        free = ControlConfig(method="topk", rungs=(0.5, 0.25))
+        assert hideable_budget_ms(free, compute_ms=8.0,
+                                  hideable_fraction=0.5) == 4.0
+        with pytest.raises(ValueError, match="compute_ms"):
+            hideable_budget_ms(free)
+
+    def test_rule_directions(self):
+        c = Controller(self.CFG)
+        assert c._decide(0, 2.0, 1.0) == (1, "down")      # above the band
+        assert c._decide(2, 2.0, 1.0) == (2, "hold")      # floor pins
+        assert c._decide(0, 0.1, 1.0) == (0, "hold")      # ceiling pins
+        # below the band AND the 2x-projected comm still fits -> up
+        assert c._decide(1, 0.6, 1.0) == (0, "up")
+        # below the band but the cheaper rung would blow the band -> hold
+        # (0.7 * 2 = 1.4 > 1.25): the anti-ping-pong projection
+        assert c._decide(1, 0.7, 1.0) == (1, "hold")
+        assert c._decide(0, 1.1, 1.0) == (0, "hold")      # inside the band
+
+    def test_window_accounting_on_applied_clock(self):
+        cfg = dataclasses.replace(self.CFG, window=4)
+        c = Controller(cfg)
+        cs = init_control_state(cfg)
+        sig = c.window_signals(mean_bits=1e6)  # 10 ms >> 1 ms budget
+        cs, decs = c.tick(cs, applied=2, signals=sig)
+        assert decs == [] and int(cs.win_updates) == 2
+        # a skip-only span (applied clock frozen) is a no-op tick
+        cs2, decs = c.tick(cs, applied=2, signals=sig)
+        assert decs == [] and cs2 is cs
+        cs, (dec,) = c.tick(cs, applied=5, signals=sig)
+        assert (dec.index, dec.applied, dec.window_start) == (0, 5, 0)
+        assert dec.updates == 5 and dec.direction == "down"
+        assert (dec.rung_from, dec.rung_to) == (0, 1)
+        assert dec.comm_ms == pytest.approx(10.0)
+        # the window closed: accumulators reset, cursor advanced
+        assert int(cs.win_updates) == 0 and float(cs.win_bits) == 0.0
+        assert int(cs.window_start) == 5 and int(cs.decisions) == 1
+
+    def test_every_close_emits_including_holds(self):
+        rec = _Recorder()
+        cfg = dataclasses.replace(self.CFG, window=1)
+        c = Controller(cfg, events=rec)
+        cs = init_control_state(cfg)
+        # in-band comm: a hold, but still a decision record
+        sig = c.window_signals(mean_bits=1.0e5)  # 1.0 ms == budget
+        cs, (dec,) = c.tick(cs, applied=1, signals=sig)
+        assert dec.direction == "hold"
+        assert [k for k, _ in rec.events] == ["control_decision"]
+        assert rec.events[0][1]["knob"] == "ratio"
+        assert rec.events[0][1]["direction"] == "hold"
+
+    def test_metrics_and_heartbeat_surfaces(self):
+        c = Controller(self.CFG)
+        cs = init_control_state(self.CFG)
+        m = c.metrics(cs)
+        assert set(m) == {"control/rung", "control/value",
+                          "control/decisions", "control/window_updates",
+                          "control/comm_ms", "control/budget_ms"}
+        assert m["control/value"] == 0.5
+        assert c.heartbeat_fields(cs) == {"control_rung": 0,
+                                          "control_value": 0.5}
+        # off state (control == ()) exports nothing
+        assert c.metrics(()) == {} and c.heartbeat_fields(()) == {}
+
+
+# ------------------------------------------------- closed-loop convergence
+
+class TestClosedLoop:
+    def test_converges_to_fitting_rung_from_both_sides(self):
+        """The acceptance loop: synthetic comm exceeding the hideable
+        budget converges DOWN to the rung whose (ratio-proportional) comm
+        fits the band, within a handful of windows — and an over-compressed
+        start converges UP to the same rung."""
+        cfg = ControlConfig(method="topk", rungs=(0.5, 0.25, 0.125),
+                            window=2, deadband=0.25, budget_ms=1.0,
+                            bandwidth_mbps=100.0)
+
+        def run(start_rung, n_windows=6):
+            c = Controller(cfg)
+            cs = init_control_state(
+                dataclasses.replace(cfg, start_rung=start_rung))
+            trail = []
+            for w in range(n_windows):
+                # billed bits track the live rung's keep ratio: 4e5 * ratio
+                # bits/update -> comm 2.0/1.0/0.5 ms at rungs 0/1/2
+                bits = 4e5 * cfg.rungs[int(cs.rung)]
+                cs, decs = c.tick(cs, applied=2 * (w + 1),
+                                  signals=c.window_signals(mean_bits=bits))
+                assert len(decs) == 1
+                trail.append(int(cs.rung))
+            return trail
+
+        down = run(start_rung=0)
+        up = run(start_rung=2)
+        # rung 1 (comm 1.0 == budget) is the equilibrium from either side,
+        # reached within N windows and held thereafter
+        assert down[0] == 1 and set(down[1:]) == {1}, down
+        assert up[0] == 1 and set(up[1:]) == {1}, up
+
+    def test_decisions_bitwise_through_state_round_trip(self):
+        """Crash/resume at the ControlState layer: serialise mid-window
+        (the Orbax dict form, through JSON to prove no live-object
+        smuggling), resume with a FRESH Controller, and the decision
+        stream matches the uninterrupted run field for field."""
+        cfg = ControlConfig(method="topk", rungs=(0.5, 0.25, 0.125),
+                            window=3, budget_ms=0.5)
+        ticks = [(i + 1, 1e6) for i in range(10)]  # applied, bits
+
+        def span(cs, controller, lo, hi):
+            out = []
+            for applied, bits in ticks[lo:hi]:
+                cs, decs = controller.tick(
+                    cs, applied=applied,
+                    signals=controller.window_signals(mean_bits=bits))
+                out += decs
+            return cs, out
+
+        clean_cs, clean = span(init_control_state(cfg), Controller(cfg),
+                               0, len(ticks))
+        # interrupt mid-window (tick 4 of window 2), round-trip the state
+        cs, pre = span(init_control_state(cfg), Controller(cfg), 0, 4)
+        blob = json.dumps({k: np.asarray(v).tolist()
+                           for k, v in control_to_dict(cs).items()})
+        cs2 = control_from_dict(json.loads(blob))
+        assert int(cs2.win_updates) == 1  # the open window rode the blob
+        cs2, post = span(cs2, Controller(cfg), 4, len(ticks))
+        assert pre + post == clean
+        for a, b in zip(jax.tree.leaves(clean_cs), jax.tree.leaves(cs2)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------- harness surface
+
+class TestHarnessWiring:
+    def _args(self, extra=()):
+        from tpu_compressed_dp.harness import dawn
+
+        return dawn.build_parser().parse_args(
+            ["--synthetic", "--method", "Topk", "--compress", "layerwise",
+             "--ratio", "0.5", "--error_feedback"] + list(extra))
+
+    def test_build_control_defaults_and_rungs_flag(self):
+        from tpu_compressed_dp.harness.loop import (build_control,
+                                                    control_summary)
+
+        comp = CompressionConfig(method="topk", ratio=0.5,
+                                 error_feedback=True)
+        assert build_control(self._args(), comp) is None  # flag off
+        cfg = build_control(self._args(["--adaptive"]), comp)
+        assert cfg.method == "topk" and cfg.rungs[0] == 0.5
+        assert cfg.window == 8 and cfg.signal == "modeled"
+        explicit = build_control(
+            self._args(["--adaptive", "--adaptive_rungs", "0.5,0.1,0.02",
+                        "--adaptive_window", "3"]), comp)
+        assert explicit.rungs == (0.5, 0.1, 0.02) and explicit.window == 3
+        # summary accounting: live rung + knob value; {} when off
+        ctl = Controller(cfg)
+        assert control_summary(ctl, init_control_state(cfg)) == {
+            "rung": 0.0, "ratio": 0.5}
+        assert control_summary(None, ()) == {}
+
+    def test_build_control_refuses_untunable_method(self):
+        from tpu_compressed_dp.harness.loop import build_control
+
+        comp = CompressionConfig(method="terngrad")
+        with pytest.raises(SystemExit, match="tunable"):
+            build_control(self._args(["--adaptive"]), comp)
+
+    def test_dawn_refuses_adaptive_plus_ratio_warmup(self, tmp_path):
+        from tpu_compressed_dp.harness import dawn
+
+        args = dawn.build_parser().parse_args(
+            ["--synthetic", "--log_dir", str(tmp_path), "--method", "topk",
+             "--compress", "layerwise", "--ratio", "0.1", "--adaptive",
+             "--ratio_warmup_epochs", "4", "--epochs", "1"])
+        with pytest.raises(ValueError, match="pick one"):
+            dawn.run(args)
+
+    def test_lm_refuses_pipeline_and_rank_knob(self):
+        from tpu_compressed_dp.harness import lm
+
+        with pytest.raises(ValueError, match="pipeline"):
+            lm.main(["--preset", "tiny", "--dp", "2", "--pp", "2",
+                     "--tp", "1", "--sp", "1", "--seq_len", "64",
+                     "--global_batch", "8", "--microbatches", "2",
+                     "--steps", "1", "--fp32", "--compress", "entiremodel",
+                     "--method", "topk", "--ratio", "0.1", "--adaptive"])
+        with pytest.raises(ValueError, match="CNN-harness-only"):
+            lm.main(["--preset", "tiny", "--dp", "2", "--tp", "2",
+                     "--sp", "2", "--seq_len", "64", "--global_batch", "8",
+                     "--steps", "1", "--fp32", "--compress", "entiremodel",
+                     "--method", "powersgd", "--rank", "4",
+                     "--error_feedback", "--adaptive"])
+
+
+# ------------------------------------------------------------------ elastic
+
+def test_remesh_recomputes_rung_targets_without_wedging(mesh8):
+    """A W-1 elastic remesh mid-adaptive-run: the step variant is rebuilt
+    for the CURRENT rung over the survivor mesh, the controller keeps
+    deciding on the applied-update clock, and the next rung switch traces
+    cleanly at W-1 (no wedge, no stale-mesh step)."""
+    from tpu_compressed_dp.models.common import init_model, make_apply_fn
+    from tpu_compressed_dp.parallel.dp import init_comp_state, init_ef_state
+    from tpu_compressed_dp.train.elastic import (ElasticConfig,
+                                                 ElasticRuntime, PeerFailed)
+    from tpu_compressed_dp.train.optim import SGD
+    from tpu_compressed_dp.train.state import TrainState
+    from tpu_compressed_dp.train.step import make_train_step
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    base = CompressionConfig(method="topk", ratio=0.5, error_feedback=True,
+                             granularity="entiremodel")
+    cfg = ControlConfig(method="topk", rungs=(0.5, 0.25, 0.125), window=1,
+                        budget_ms=0.5)
+    module = Tiny()
+    params, stats = init_model(module, jax.random.key(0),
+                               jnp.zeros((1, 4, 4, 3), jnp.float32))
+    opt = SGD(lr=0.05, momentum=0.0)
+    W = int(mesh8.shape["data"])
+    state = TrainState.create(
+        params, stats, opt.init(params), init_ef_state(params, base, W),
+        jax.random.key(1), comp=init_comp_state(params, base, W),
+        control=init_control_state(cfg))
+    controller = Controller(cfg)
+    el = ElasticRuntime(ElasticConfig(ef_policy="fold"), mesh8,
+                        log=lambda s: None)
+    rng = np.random.RandomState(0)
+    batch = {"input": jnp.asarray(rng.randn(56, 4, 4, 3).astype(np.float32)),
+             "target": jnp.asarray(rng.randint(0, 4, 56).astype(np.int32))}
+
+    def step_for(rung):
+        return make_train_step(make_apply_fn(module), opt,
+                               comp_for_rung(base, cfg, rung), el.mesh,
+                               donate=False)
+
+    def one_step(state):
+        state, _ = step_for(int(state.control.rung))(state, batch)
+        new_control, decs = controller.tick(
+            state.control, applied=int(state.step),
+            signals=controller.window_signals(mean_bits=1e6))
+        return state.replace(control=new_control), decs
+
+    state, decs = one_step(state)           # window closes: rung 0 -> 1
+    assert decs[0].direction == "down" and int(state.control.rung) == 1
+
+    state = el.handle_failure(state, PeerFailed((3,), step=1, reason="t"))
+    assert el.world == W - 1
+    # the survivor mesh retraces the CURRENT rung's variant and the
+    # controller advances to the next rung target — nothing wedges
+    state, decs = one_step(state)
+    assert int(state.step) == 2
+    assert decs[0].direction == "down" and int(state.control.rung) == 2
+    for leaf in jax.tree.leaves(state.ef):
+        assert np.asarray(leaf).shape[0] == W - 1
+
+
+# ---------------------------------------------------------------- dawn e2e
+
+def test_dawn_adaptive_e2e_and_control_report(tmp_path, mesh8):
+    """The acceptance run: dawn under ``--adaptive`` with comm priced far
+    above a pinned budget descends the rung ladder (the per-epoch sent
+    fraction PROVES each rung's step variant actually ran), emits
+    ``control_decision`` events and per-epoch control metrics, and
+    tools/control_report.py + trace_report --control parse it all back."""
+    from tpu_compressed_dp.harness import dawn
+
+    ev_path = str(tmp_path / "events.jsonl")
+    args = dawn.build_parser().parse_args(
+        ["--synthetic", "--synthetic_n", "512", "--channels_scale", "0.125",
+         "--log_dir", str(tmp_path), "--batch_size", "64", "--devices", "8",
+         "--epochs", "3", "--momentum", "0.9", "--compress", "layerwise",
+         "--method", "topk", "--ratio", "0.5", "--error_feedback",
+         "--overlap", "2", "--adaptive", "--adaptive_window", "1",
+         "--adaptive_budget_ms", "0.001", "--events", ev_path,
+         "--prom", str(tmp_path / "m.prom")])
+    summary = dawn.run(args)
+    # window=1 at epoch cadence: one rung down per epoch, and the billed
+    # sent fraction tracks the LIVE rung (0.25 traced for epoch 2's step)
+    assert summary["rung"] == 3.0 and summary["ratio"] == 0.0625
+    assert summary["sent frac"] == pytest.approx(0.125, rel=0.05)
+
+    from tpu_compressed_dp.obs import export as obs_export
+
+    events = obs_export.read_events(ev_path)
+    decs = [e for e in events if e["kind"] == "control_decision"]
+    assert [d["rung_to"] for d in decs] == [1, 2, 3]
+    assert all(d["direction"] == "down" and d["knob"] == "ratio"
+               for d in decs)
+    epochs_rec = [e for e in events if e["kind"] == "epoch"]
+    assert [e["control"]["control/rung"] for e in epochs_rec] == [1., 2., 3.]
+    assert all(e["control"]["control/value"] == pytest.approx(
+        0.5 * 2.0 ** -e["control"]["control/rung"]) for e in epochs_rec)
+
+    # the offline reports parse the stream back
+    import tools.control_report as cr
+    import tools.trace_report as tr
+
+    report = cr.render_report(events)
+    assert "rung trajectory" in report and "down (0.5 -> 0.25)" in report
+    assert "final rung=3" in report
+    s = cr.summarize(cr.decision_rows(events))
+    assert s["decisions"] == 3 and s["by_direction"] == {"down": 3}
+    assert s["final_value"] == 0.0625 and s["converged"] is False
+    assert cr.window_rows(events)[-1]["rung"] == 3.0
+    assert tr.main([ev_path, "--control"]) == 0
+
+    # registry-declared control/* gauges land on the Prometheus textfile
+    prom = (tmp_path / "m.prom").read_text()
+    assert "tcdp_control_rung" in prom and "tcdp_control_value" in prom

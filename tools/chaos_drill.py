@@ -62,6 +62,14 @@ Elastic drills (the ISSUE 7 acceptance row — train/elastic.py):
   * ``elastic_matrix`` — the kill-step x worker x EF-policy cross, plus a
     wire+sharded-transport variant (the owner partition recomputes at W-1).
 
+Control drill (the ISSUE 11 acceptance row — control/):
+
+  * ``control_resume`` — a crash-relaunch mid-decision-window resumes the
+    adaptive compression controller bitwise: the checkpointed ControlState
+    carries the open window's accumulators, so the relaunched run replays
+    the same rung schedule and the same ``control_decision`` events, field
+    for field, as the uninterrupted run.
+
 Usage::
 
     python tools/chaos_drill.py --quick     # tier-1 smoke subset (~4 drills)
@@ -108,10 +116,11 @@ def _mesh(n=8):
 
 
 def _tiny_setup(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9, seed=0,
-                with_factory=False):
+                with_factory=False, control_cfg=None):
     """TinyMLP + optimizer + state + guarded train step on ``mesh``."""
     import flax.linen as nn
 
+    from tpu_compressed_dp.control import init_control_state
     from tpu_compressed_dp.models.common import init_model, make_apply_fn
     from tpu_compressed_dp.parallel.dp import init_comp_state, init_ef_state
     from tpu_compressed_dp.train.guard import init_guard_state
@@ -136,13 +145,15 @@ def _tiny_setup(mesh, comp_cfg, guard_cfg, chaos, *, momentum=0.9, seed=0,
         init_ef_state(params, comp_cfg, ndev), jax.random.key(seed + 1),
         comp=init_comp_state(params, comp_cfg, ndev),
         guard=init_guard_state(guard_cfg),
+        control=init_control_state(control_cfg),
     )
 
-    def step_for(m):
+    def step_for(m, cfg=comp_cfg):
         # the elastic drills rebuild the step over the W-1 mesh — same
         # module/opt/config, new world (the sharded transport's owner
-        # partition recomputes at trace time)
-        return make_train_step(make_apply_fn(module), opt, comp_cfg, m,
+        # partition recomputes at trace time); the control drill rebuilds
+        # it per RUNG (same mesh, new compression config)
+        return make_train_step(make_apply_fn(module), opt, cfg, m,
                                guard_cfg=guard_cfg, chaos=chaos, donate=False)
 
     step = step_for(mesh)
@@ -534,6 +545,95 @@ def drill_ckpt_corrupt(mesh, *, n_steps=4) -> Dict:
     return {"rollback_steps": 1, "restored_step": n_steps - 1}
 
 
+def drill_control_resume(mesh, *, preempt_at_step=4, n_steps=9) -> Dict:
+    """Crash-relaunch MID-decision-window resumes the adaptive controller
+    bitwise: the saved ControlState (riding the checkpoint next to guard)
+    carries the open window's accumulators, so the relaunched run replays
+    the SAME rung schedule and the SAME ``control_decision`` events,
+    field for field, as the uninterrupted run — the modeled signal makes
+    every decision a pure function of checkpointed state."""
+    from tpu_compressed_dp.control import (ControlConfig, Controller,
+                                           comp_for_rung)
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.guard import GuardConfig
+    from tpu_compressed_dp.utils.checkpoint import Checkpointer
+
+    base = CompressionConfig(method="topk", ratio=0.5, error_feedback=True)
+    # window=3, preempt at 4 => the crash lands one update INTO a window;
+    # modeled comm (1e6 bits @ 100 Mbit/s = 10 ms/update) >> the pinned
+    # 0.5 ms budget, so the schedule is down, down, then hold at the floor
+    ctrl_cfg = ControlConfig(method="topk", rungs=(0.5, 0.25, 0.125),
+                             window=3, budget_ms=0.5)
+    gcfg = GuardConfig(loss_scaling=False)
+    batches = [_batch(seed=s) for s in range(n_steps)]
+    bits_per_update = 1e6
+
+    def span(state, step_for, controller, i0, i1):
+        cache = {}
+        for i in range(i0, i1):
+            rung = int(np.asarray(state.control.rung))
+            if rung not in cache:
+                cache[rung] = step_for(mesh, comp_for_rung(base, ctrl_cfg,
+                                                           rung))
+            state, _ = cache[rung](state, batches[i])
+            new_control, _ = controller.tick(
+                state.control, applied=int(state.step),
+                signals=controller.window_signals(mean_bits=bits_per_update))
+            state = state.replace(control=new_control)
+        return state
+
+    def decisions(rec):
+        return [(k, f) for k, f in rec.events if k == "control_decision"]
+
+    # the uninterrupted run
+    rec_clean = _Recorder()
+    clean, _, step_for = _tiny_setup(mesh, base, gcfg, None,
+                                     with_factory=True, control_cfg=ctrl_cfg)
+    clean = span(clean, step_for, Controller(ctrl_cfg, events=rec_clean),
+                 0, n_steps)
+
+    with tempfile.TemporaryDirectory() as td:
+        # first life: preempt mid-window, emergency save
+        rec_a = _Recorder()
+        s1, _, sf1 = _tiny_setup(mesh, base, gcfg, None, with_factory=True,
+                                 control_cfg=ctrl_cfg)
+        s1 = span(s1, sf1, Controller(ctrl_cfg, events=rec_a),
+                  0, preempt_at_step)
+        ckpt = Checkpointer(td)
+        ckpt.save(s1, {"step_i": preempt_at_step, "emergency": True})
+        ckpt.close()
+
+        # "relaunch": fresh process state, restore, finish the run
+        rec_b = _Recorder()
+        s2, _, sf2 = _tiny_setup(mesh, base, gcfg, None, with_factory=True,
+                                 control_cfg=ctrl_cfg)
+        ckpt2 = Checkpointer(td)
+        s2, meta = ckpt2.restore(s2)
+        ckpt2.close()
+        s2 = s2.with_mesh_sharding(mesh)
+        assert int(meta["step_i"]) == preempt_at_step, meta
+        # the open window's accumulation rode the checkpoint
+        assert int(np.asarray(s2.control.win_updates)) == \
+            preempt_at_step % ctrl_cfg.window, jax.device_get(s2.control)
+        s2 = span(s2, sf2, Controller(ctrl_cfg, events=rec_b),
+                  preempt_at_step, n_steps)
+
+    fields = ("params", "opt_state", "batch_stats", "ef", "control")
+    _assert_bitwise(_snap(clean, fields), _snap(s2, fields),
+                    "control_resume state")
+    assert int(clean.step) == int(s2.step) == n_steps
+    # the decision STREAM is identical: pre-crash events + post-crash
+    # events == the uninterrupted run's, field for field
+    assert decisions(rec_a) + decisions(rec_b) == decisions(rec_clean), (
+        decisions(rec_a) + decisions(rec_b), decisions(rec_clean))
+    rungs = [f["rung_to"] for _, f in decisions(rec_clean)]
+    dirs = [f["direction"] for _, f in decisions(rec_clean)]
+    assert rungs == [1, 2, 2], rungs
+    assert dirs == ["down", "down", "hold"], dirs
+    return {"decisions": len(rungs), "rungs": rungs,
+            "resumed_mid_window": True}
+
+
 # ----------------------------------------------------------- elastic drills
 
 def drill_elastic_gossip(mesh=None) -> Dict:
@@ -748,7 +848,8 @@ def drill_elastic_cascade(mesh) -> Dict:
 # -------------------------------------------------------------------- main
 
 QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery",
-         "elastic_gossip", "elastic_remesh", "ckpt_preempt", "ckpt_corrupt"]
+         "elastic_gossip", "elastic_remesh", "ckpt_preempt", "ckpt_corrupt",
+         "control_resume"]
 FULL = QUICK + ["comp_hold", "ef_identity", "poison_control",
                 "skip_matrix", "ef_identity_sharded",
                 "elastic_readmit", "elastic_cascade", "elastic_matrix"]
@@ -821,7 +922,8 @@ def main(argv=None) -> int:
     p.add_argument("--quick", action="store_true",
                    help="tier-1 smoke subset (skip_consistency, loss_scale, "
                         "max_skips, crash_recovery, elastic_gossip, "
-                        "elastic_remesh, ckpt_preempt, ckpt_corrupt)")
+                        "elastic_remesh, ckpt_preempt, ckpt_corrupt, "
+                        "control_resume)")
     p.add_argument("--drill", action="append", default=None,
                    help="run only the named drill(s)")
     p.add_argument("--list", action="store_true",

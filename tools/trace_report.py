@@ -194,6 +194,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="also render the per-chunk collective placement "
                         "from an overlap_evidence output file "
                         "(benchmarks/overlap_hlo_r8.txt)")
+    p.add_argument("--control", action="store_true",
+                   help="also render the adaptive-controller rung "
+                        "trajectory (control_decision records; see "
+                        "tools/control_report.py for the full report)")
     args = p.parse_args(argv)
     events = read_events(args.events)
     if args.json:
@@ -201,11 +205,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "throughput": throughput_rows(events)}
         if args.schedule:
             payload["schedule"] = render_schedule(args.schedule).splitlines()
+        if args.control:
+            try:
+                from tools.control_report import decision_rows, summarize
+            except ImportError:  # script mode: sys.path[0] is tools/
+                from control_report import decision_rows, summarize
+            decs = decision_rows(events)
+            payload["control"] = {"decisions": decs,
+                                  "summary": summarize(decs)}
         print(json.dumps(payload, indent=2))
     else:
         print(render_report(events))
         if args.schedule:
             print(render_schedule(args.schedule))
+        if args.control:
+            try:
+                from tools.control_report import (
+                    render_report as render_control)
+            except ImportError:  # script mode: sys.path[0] is tools/
+                from control_report import render_report as render_control
+            print("")
+            print(render_control(events))
     if args.chrome:
         with open(args.chrome, "w") as f:
             json.dump({"traceEvents": chrome_trace_events(events),

@@ -55,6 +55,14 @@ REPLAY_DETERMINISTIC_MODULES = (
     "tpu_compressed_dp/train/elastic.py",
     "tpu_compressed_dp/train/rendezvous.py",
     "tpu_compressed_dp/train/guard.py",
+    # the adaptive-compression control plane: decisions must replay
+    # bitwise across crash/resume (the 'modeled' signal is a pure function
+    # of checkpointed state + analytic comm stats — no clock reads)
+    "tpu_compressed_dp/control/config.py",
+    "tpu_compressed_dp/control/controller.py",
+    "tpu_compressed_dp/control/rungs.py",
+    "tpu_compressed_dp/control/signals.py",
+    "tpu_compressed_dp/control/state.py",
 )
 
 #: modules that write records other processes read over shared storage —
@@ -70,7 +78,7 @@ SHARED_DIR_MODULES = (
 #: registry-governed stat-key families (TCDP103); literals shaped
 #: "<family>/<name>" with these families must be declared
 STAT_FAMILIES = ("comm", "guard", "elastic", "ckpt", "throughput", "time",
-                 "net")
+                 "net", "control")
 STAT_KEY_RE = re.compile(r"^(?:%s)/[a-z0-9_]+$" % "|".join(STAT_FAMILIES))
 
 _WALLCLOCK_CALLS = frozenset({

@@ -585,6 +585,37 @@ def _check_train_step(profile: str) -> Tuple[List[Finding], int]:
                 config=label)
             findings += check_barrier_chain(closed, n_chunks=len(plans),
                                             config=label)
+    # adaptive-controller rung pair: a rung switch swaps one trace-cached
+    # step variant for another, and TCDP002 pins the only thing allowed to
+    # change — the k-dependent operand SHAPES.  The ordered (primitive,
+    # axis) program must be identical across rungs, or a mid-run switch
+    # would reorder/add collectives and desync any worker that traced the
+    # other rung.
+    from tpu_compressed_dp.control import (ControlConfig, comp_for_rung,
+                                           init_control_state)
+
+    ctrl = ControlConfig(method="topk", rungs=(0.25, 0.125))
+    rung_sigs = {}
+    for rung in (0, 1):
+        rcfg = comp_for_rung(cfgs[0], ctrl, rung)
+        label = _cfg_label(rcfg, suffix=f"/step(rung={rung})")
+        ef = init_ef_state(params, rcfg, num_devices=mesh.shape["data"])
+        comp = init_comp_state(params, rcfg, num_devices=mesh.shape["data"])
+        state = TrainState.create(params, stats, opt.init(params), ef,
+                                  jax.random.key(1), comp=comp,
+                                  guard=init_guard_state(guard_cfg),
+                                  control=init_control_state(ctrl))
+        step = make_train_step(apply_fn, opt, rcfg, mesh, grad_scale=1.0,
+                               donate=True, guard_cfg=guard_cfg)
+        closed = jax.make_jaxpr(step)(state, batch)
+        n += 1
+        findings += check_control_flow(closed, config=label)
+        findings += check_donation(step, (state, batch), (0,), config=label)
+        rung_sigs[rung] = collective_signature(closed)
+    findings += check_signature_match(
+        [s[:2] for s in rung_sigs[0]], [s[:2] for s in rung_sigs[1]],
+        "rung0 (prim, axes)", "rung1 (prim, axes)",
+        config="topk/step(rung-pair)")
     return findings, n
 
 

@@ -45,7 +45,7 @@ from tpu_compressed_dp.train.optim import SGD
 from tpu_compressed_dp.train.state import TrainState
 from tpu_compressed_dp.train.step import make_train_step
 
-__all__ = ["run_point", "run_sweep", "main"]
+__all__ = ["run_point", "run_adaptive_point", "run_sweep", "main"]
 
 
 def _build_model(name: str, image_size: int, num_classes: int,
@@ -261,6 +261,208 @@ def run_point(
     return record
 
 
+def run_adaptive_point(
+    *,
+    model: str = "resnet9",
+    method: str = "topk",
+    granularity: str = "layerwise",
+    mode: str = "simulate",
+    transport: str = "allgather",
+    ratio: float = 0.25,
+    rank: int = 4,
+    error_feedback: bool = False,
+    sync_overlap: int = 1,
+    batch_size: int = 512,
+    image_size: int = 128,
+    num_classes: int = 1000,
+    windows: int = 6,
+    window: int = 2,
+    rungs: Optional[tuple] = None,
+    budget_ms: float = 0.0,
+    bw_mbps: float = 100.0,
+    deadband: float = 0.25,
+    devices: Optional[int] = None,
+    channels_scale: float = 1.0,
+) -> Dict:
+    """Run the closed-loop controller on one (method, granularity) point and
+    bill it against every static rung — the adaptive-vs-best-static record
+    (BENCH_r09 protocol).
+
+    The measured half runs ``windows`` decision windows of ``window`` steps
+    each through the real rung-switching loop (trace-cached step variant per
+    visited rung, ``Controller.tick`` keyed to applied updates, PowerSGD
+    warm-column migration on rank switches — the ``harness/dawn.py`` loop
+    minus dataset/checkpoint plumbing).  The comparison half then times
+    ``window`` steps at EVERY rung from a fresh state and picks the best
+    static point: the least-compressed rung whose modeled comm time fits the
+    hideable budget — the oracle the controller is supposed to converge to
+    without being told the answer.
+
+    Returns one nested record: ``window_trace`` (per-window rung / step-time
+    / billed-bits trajectory), ``static_rungs``, ``best_static`` and the
+    billed-bits comparison.  ``budget_ms=0`` derives the budget from the
+    measured step wall time scaled by the overlap schedule's hideable byte
+    fraction, exactly as the harnesses do.
+    """
+    from tpu_compressed_dp.control import (ControlConfig, Controller,
+                                           build_ladder, comp_for_rung,
+                                           init_control_state, ladder_knob,
+                                           migrate_comp_state)
+    from tpu_compressed_dp.parallel.overlap import (hideable_byte_fraction,
+                                                    plan_chunks)
+
+    mesh = make_data_mesh(devices)
+    ndev = mesh.shape["data"]
+    bs = batch_size if batch_size % ndev == 0 else (batch_size // ndev + 1) * ndev
+
+    module, sz, ncls = _build_model(model, image_size, num_classes, channels_scale)
+    params, stats = init_model(
+        module, jax.random.key(0), jnp.zeros((1, sz, sz, 3), jnp.float32)
+    )
+    apply_fn = make_apply_fn(module)
+    opt = SGD(lr=0.01, momentum=0.9, weight_decay=5e-4)
+    base = CompressionConfig(
+        method=method, granularity=granularity, mode=mode, ratio=ratio,
+        transport=transport, rank=rank, error_feedback=error_feedback,
+        sync_overlap=sync_overlap,
+    )
+    canon = canonical_name(method)
+    ctrl = ControlConfig(
+        method=canon,
+        rungs=tuple(rungs) if rungs else build_ladder(canon, ratio, rank),
+        window=window, deadband=deadband, signal="modeled",
+        bandwidth_mbps=bw_mbps, budget_ms=budget_ms,
+    )
+    controller = Controller(ctrl)
+    knob = ladder_knob(canon)
+    hide_frac = hideable_byte_fraction(plan_chunks(
+        [leaf.size * 4 for leaf in jax.tree_util.tree_leaves(params)], base))
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input": jnp.asarray(rng.standard_normal((bs, sz, sz, 3), dtype=np.float32)),
+        "target": jnp.asarray(rng.integers(0, ncls, size=(bs,), dtype=np.int32)),
+    }
+
+    step_cache: Dict[int, object] = {}
+
+    def step_for(rung: int):
+        if rung not in step_cache:
+            # donate=False: the static half rebuilds fresh states from the
+            # same `params` tree after the adaptive half has stepped, so
+            # the buffers must survive the calls
+            step_cache[rung] = make_train_step(
+                apply_fn, opt, comp_for_rung(base, ctrl, rung), mesh,
+                grad_scale=1.0, donate=False)
+        return step_cache[rung]
+
+    def fresh_state(rung: int):
+        rcfg = comp_for_rung(base, ctrl, rung)
+        return TrainState.create(
+            params, stats, opt.init(params), init_ef_state(params, rcfg, ndev),
+            jax.random.key(1), comp=init_comp_state(params, rcfg, ndev),
+            control=init_control_state(ctrl),
+        )
+
+    # ---------------------------------------------------- adaptive half
+    state = fresh_state(0)
+    window_trace: List[Dict] = []
+    adaptive_bits = 0.0
+    for w in range(windows):
+        rung = int(np.asarray(state.control.rung))
+        train_step = step_for(rung)
+        if len(window_trace) == 0 or window_trace[-1]["rung"] != rung:
+            # first entry into this rung: one untimed step eats the compile
+            # (it still counts as an applied update for the tick below)
+            state, metrics = train_step(state, batch)
+            jax.device_get(metrics)
+        t0 = time.perf_counter()
+        for _ in range(window):
+            state, metrics = train_step(state, batch)
+        metrics = jax.device_get(metrics)
+        step_ms = (time.perf_counter() - t0) / window * 1e3
+        bits = float(metrics.get("comm/sent_bits", 0.0))
+        signals = controller.window_signals(
+            mean_bits=bits, compute_ms=step_ms,
+            hideable_fraction=hide_frac)
+        new_control, decisions = controller.tick(
+            state.control, applied=int(state.step), signals=signals)
+        state = state.replace(control=new_control)
+        new_rung = int(np.asarray(new_control.rung))
+        if new_rung != rung and knob == "rank":
+            state = state.replace(comp=migrate_comp_state(
+                state.comp, params, comp_for_rung(base, ctrl, rung),
+                comp_for_rung(base, ctrl, new_rung), ndev))
+        dec = decisions[0] if decisions else None
+        updates = window + (1 if len(window_trace) == 0
+                            or window_trace[-1]["rung"] != rung else 0)
+        adaptive_bits += bits * updates
+        window_trace.append({
+            "window": w, "rung": rung,
+            "value": ctrl.rungs[rung],
+            "step_ms": round(step_ms, 3),
+            "bits_per_update": bits,
+            "comm_ms": round(signals.comm_ms, 4),
+            "budget_ms": round(signals.budget_ms, 4),
+            "direction": dec.direction if dec else None,
+            "rung_to": new_rung,
+        })
+    # ------------------------------------------------------ static half
+    static_rungs: List[Dict] = []
+    for rung in range(len(ctrl.rungs)):
+        s = fresh_state(rung)
+        train_step = step_for(rung)
+        s, m = train_step(s, batch)
+        jax.device_get(m)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(window):
+            s, m = train_step(s, batch)
+        m = jax.device_get(m)
+        step_ms = (time.perf_counter() - t0) / window * 1e3
+        bits = float(m.get("comm/sent_bits", 0.0))
+        sig = controller.window_signals(
+            mean_bits=bits, compute_ms=step_ms, hideable_fraction=hide_frac)
+        static_rungs.append({
+            "rung": rung, "value": ctrl.rungs[rung],
+            "step_ms": round(step_ms, 3),
+            "bits_per_update": bits,
+            "comm_ms": round(sig.comm_ms, 4),
+            "budget_ms": round(sig.budget_ms, 4),
+            "fits_budget": sig.comm_ms <= sig.budget_ms,
+        })
+    fitting = [r for r in static_rungs if r["fits_budget"]]
+    best = fitting[0] if fitting else static_rungs[-1]
+    n_updates = sum(window + (1 if i == 0 or window_trace[i - 1]["rung"]
+                              != t["rung"] else 0)
+                    for i, t in enumerate(window_trace))
+    best_static_bits = best["bits_per_update"] * n_updates
+    record: Dict = {
+        "model": model, "method": canon, "granularity": granularity,
+        "mode": mode, "adaptive": True, "knob": knob,
+        "rungs": list(ctrl.rungs), "window": window, "windows": windows,
+        "deadband": deadband, "bw_mbps": bw_mbps,
+        "budget_ms": budget_ms,
+        "error_feedback": bool(error_feedback),
+        "devices": ndev, "batch": bs,
+        "window_trace": window_trace,
+        "static_rungs": static_rungs,
+        "best_static": {"rung": best["rung"], "value": best["value"]},
+        "final_rung": int(np.asarray(state.control.rung)),
+        "final_value": ctrl.rungs[int(np.asarray(state.control.rung))],
+        "decisions": int(np.asarray(state.control.decisions)),
+        "updates": n_updates,
+        "adaptive_billed_bits": adaptive_bits,
+        "best_static_billed_bits": best_static_bits,
+        "billed_bits_ratio": round(
+            adaptive_bits / best_static_bits, 4) if best_static_bits else None,
+        "converged_to_best_static": (
+            int(np.asarray(state.control.rung)) == best["rung"]),
+    }
+    if channels_scale != 1.0:
+        record["channels_scale"] = channels_scale
+    return record
+
+
 def run_sweep(args) -> List[Dict[str, float]]:
     methods = [m.strip() for m in args.methods.split(",") if m.strip()]
     ratios = [float(r) for r in args.ratios.split(",")]
@@ -271,6 +473,41 @@ def run_sweep(args) -> List[Dict[str, float]]:
     def emit(rec):
         records.append(rec)
         print(json.dumps(rec), flush=True)
+
+    if getattr(args, "adaptive", False):
+        # closed-loop comparison instead of the static grid: one nested
+        # record per (method, granularity) — per-window rung trajectory +
+        # per-rung static baselines + the best-static pick (BENCH_r09)
+        from tpu_compressed_dp.control.config import TUNABLE_METHODS
+
+        ranks = [int(r) for r in args.ranks.split(",") if r.strip()]
+        rungs = None
+        if args.adaptive_rungs:
+            vals = [float(v) for v in args.adaptive_rungs.split(",")]
+            rungs = tuple(vals)
+        for method, gran in itertools.product(methods, grans):
+            canon = canonical_name(method)
+            if canon not in TUNABLE_METHODS:
+                print(f"# skipping {method}: no ladder knob (tunable: "
+                      f"{','.join(TUNABLE_METHODS)})", file=sys.stderr)
+                continue
+            print(f"# adaptive: {method}/{gran}", file=sys.stderr)
+            emit(run_adaptive_point(
+                model=args.model, method=method, granularity=gran,
+                mode=args.mode, transport=transports[0], ratio=ratios[0],
+                rank=ranks[0], error_feedback=args.error_feedback,
+                sync_overlap=args.overlap, batch_size=args.batch_size,
+                image_size=args.image_size, num_classes=args.num_classes,
+                windows=args.adaptive_windows, window=args.adaptive_window,
+                rungs=rungs, budget_ms=args.adaptive_budget_ms,
+                bw_mbps=args.adaptive_bw_mbps,
+                deadband=args.adaptive_deadband, devices=args.devices,
+                channels_scale=args.channels_scale))
+        if args.tsv:
+            print("# --tsv skipped: adaptive records are nested "
+                  "(window_trace/static_rungs); use the JSON lines",
+                  file=sys.stderr)
+        return records
 
     common = dict(
         model=args.model, batch_size=args.batch_size, image_size=args.image_size,
@@ -395,6 +632,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sharded transport return-union buffer capacity, "
                         "in units of k/W")
     p.add_argument("--tsv", type=str, default=None)
+    p.add_argument("--adaptive", action="store_true",
+                   help="closed-loop controller comparison instead of the "
+                        "static grid: per (method, granularity), run the "
+                        "rung-switching control loop for --adaptive_windows "
+                        "decision windows and bill it against every static "
+                        "rung (control/ subsystem; BENCH_r09 protocol)")
+    p.add_argument("--adaptive_windows", type=int, default=6,
+                   help="decision windows to run the control loop for")
+    p.add_argument("--adaptive_window", type=int, default=2,
+                   help="steps (applied updates) per decision window")
+    p.add_argument("--adaptive_rungs", type=str, default=None,
+                   help="explicit comma ladder (ratios, or ranks for "
+                        "powersgd); default build_ladder anchored at "
+                        "--ratios[0] / --ranks[0]")
+    p.add_argument("--adaptive_budget_ms", type=float, default=0.0,
+                   help="pinned hideable-comm budget per update; 0 derives "
+                        "it from measured step time x the overlap "
+                        "schedule's hideable byte fraction")
+    p.add_argument("--adaptive_bw_mbps", type=float, default=100.0,
+                   help="modeled-signal link bandwidth (MB/s) for billed-"
+                        "bits -> comm-ms conversion")
+    p.add_argument("--adaptive_deadband", type=float, default=0.25,
+                   help="controller hysteresis band around the budget")
     return p
 
 

@@ -1,0 +1,46 @@
+"""Closed-loop adaptive compression control plane.
+
+The obs subsystem measures per-phase time and per-chip comm volume; this
+package closes the loop: a host-side controller consumes those signals each
+decision window and retunes per-group compression (Top-K/Random-K ``ratio``,
+PowerSGD ``rank``) to equalize comm time against the compute it can hide
+behind.  Jitted steps bake k at trace time, so tuning is a **discrete rung
+ladder** — a small static set of precompiled ratio/rank rungs
+(:mod:`tpu_compressed_dp.control.rungs`), with rung switches only at step
+boundaries (the harness swaps trace-cached step variants between epochs).
+
+House invariants, same as chaos/guard/elastic:
+
+  * decisions key off APPLIED-update counts (``guard.schedule_step``
+    semantics), so NaN-skip bursts don't desynchronise replay;
+  * every window close is a ``control_decision`` record on the ``--events``
+    stream; the default ``signal='modeled'`` derives comm time from the
+    engines' analytic billed bits, making the whole decision sequence
+    bitwise reproducible across crash/resume replays;
+  * controller state (:class:`~tpu_compressed_dp.control.state.ControlState`)
+    rides ``TrainState.control`` next to ``guard`` — replicated, donated,
+    Orbax round-tripped with the established legacy-template fallback;
+  * no module here reads the wall clock — signals are injected by the
+    harness (``analysis/hostlint.py`` lints this package replay-deterministic).
+
+Adaptive-k rule after "Layer-wise Adaptive Gradient Sparsification"
+(PAPERS.md, arXiv 1911.08727); the accuracy-vs-k backdrop is "Understanding
+Top-k Sparsification" (arXiv 1911.08772).
+"""
+
+from tpu_compressed_dp.control.config import ControlConfig
+from tpu_compressed_dp.control.controller import Controller, Decision
+from tpu_compressed_dp.control.rungs import (
+    build_ladder, comp_for_rung, ladder_knob, migrate_comp_state,
+)
+from tpu_compressed_dp.control.signals import hideable_budget_ms, modeled_comm_ms
+from tpu_compressed_dp.control.state import (
+    ControlState, control_from_dict, control_to_dict, init_control_state,
+)
+
+__all__ = [
+    "ControlConfig", "Controller", "Decision", "ControlState",
+    "init_control_state", "control_to_dict", "control_from_dict",
+    "build_ladder", "comp_for_rung", "ladder_knob", "migrate_comp_state",
+    "modeled_comm_ms", "hideable_budget_ms",
+]

@@ -1,0 +1,128 @@
+"""The discrete rung ladder: mapping ladder positions to step variants.
+
+Jitted steps bake the compression knob at trace time (Top-K's keep count and
+PowerSGD's factor shapes are static), so the controller cannot tune k
+continuously — it walks a small static ladder of precompiled rungs.  Each
+rung is a full :class:`~tpu_compressed_dp.parallel.dp.CompressionConfig`
+(:func:`comp_for_rung`), and the harness keeps one trace-cached train step
+per visited rung (the ``step_cache`` idiom ``harness/dawn.py`` already uses
+for ratio warmup).
+
+Ratio rungs (topk/blocktopk/randomk) need no state surgery: the EF residual
+is dense and ratio-independent, and those methods carry no compressor state.
+Rank rungs (powersgd) resize the warm-start ``Q`` factors —
+:func:`migrate_comp_state` re-derives the state at the new rank and copies
+the overlapping warm columns, so the power iteration keeps its converged
+subspace through a rung switch instead of re-warming from random.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_compressed_dp.control.config import (
+    ControlConfig, RANK_METHODS, RATIO_METHODS,
+)
+from tpu_compressed_dp.parallel.dp import CompressionConfig, init_comp_state
+
+__all__ = ["ladder_knob", "build_ladder", "comp_for_rung",
+           "migrate_comp_state", "rung_value"]
+
+
+def ladder_knob(method: str) -> str:
+    """Which :class:`CompressionConfig` field the ladder drives:
+    ``'ratio'`` or ``'rank'``."""
+    if method in RATIO_METHODS:
+        return "ratio"
+    if method in RANK_METHODS:
+        return "rank"
+    raise ValueError(f"no ladder knob for method {method!r}")
+
+
+def build_ladder(method: str, base_ratio: float, base_rank: int,
+                 *, depth: int = 5) -> Tuple[float, ...]:
+    """Default descending ladder anchored at the CLI-configured knob.
+
+    Ratio methods halve per rung (floored at 1e-3 — below that Top-K keeps
+    ~nothing and the EF delay diverges); powersgd halves the rank (floored
+    at 1).  Rung 0 is the configured static value, so an adaptive run that
+    never needs to act behaves exactly like the static run.
+    """
+    if ladder_knob(method) == "ratio":
+        rungs, r = [], float(base_ratio)
+        for _ in range(depth):
+            rungs.append(r)
+            r = r / 2.0
+            if r < 1e-3:
+                break
+        return tuple(rungs)
+    rungs, rk = [], int(base_rank)
+    while rk >= 1 and len(rungs) < depth:
+        rungs.append(float(rk))
+        if rk == 1:
+            break
+        rk = max(1, rk // 2)
+    return tuple(rungs)
+
+
+def rung_value(cfg: ControlConfig, rung: int) -> float:
+    """The knob value at a ladder position (bounds-checked)."""
+    if not (0 <= rung < len(cfg.rungs)):
+        raise ValueError(
+            f"rung {rung} out of range for ladder {cfg.rungs}")
+    return cfg.rungs[rung]
+
+
+def comp_for_rung(base: CompressionConfig, cfg: ControlConfig,
+                  rung: int) -> CompressionConfig:
+    """The compression config a given ladder position compiles to — the
+    trace-cache key the harness builds step variants from."""
+    val = rung_value(cfg, rung)
+    if ladder_knob(cfg.method) == "ratio":
+        return dataclasses.replace(base, ratio=val)
+    return dataclasses.replace(base, rank=int(val))
+
+
+def migrate_comp_state(comp: Any, grads_like: Any, old: CompressionConfig,
+                       new: CompressionConfig,
+                       num_devices: Optional[int] = None, *,
+                       seed: int = 0) -> Any:
+    """Carry the PowerSGD warm start across a rank rung switch.
+
+    A rank change resizes every group's ``Q`` ([..., n2, r]) and can move
+    groups across the dense-fallback boundary (``r*(m+n2) >= n``), so the
+    state is re-derived with :func:`init_comp_state` at the NEW rank —
+    deterministically, from the same seed every worker uses — and the first
+    ``min(r_old, r_new)`` warm columns are copied where a group exists at
+    both ranks (``n2`` depends only on the group size, so columns align).
+    Stateless methods and no-op switches pass through unchanged.
+    """
+    if comp == () or old.rank == new.rank:
+        return comp
+    fresh = init_comp_state(grads_like, new, num_devices, seed=seed)
+    if fresh == ():
+        return fresh
+    out = {}
+    for qk, q_new in fresh.items():
+        q_old = comp.get(qk) if isinstance(comp, dict) else None
+        if q_old is None or q_old.shape[:-1] != q_new.shape[:-1]:
+            out[qk] = q_new
+            continue
+        r_copy = min(q_old.shape[-1], q_new.shape[-1])
+        out[qk] = jnp.concatenate(
+            [q_old[..., :r_copy], q_new[..., r_copy:]], axis=-1)
+    return out
+
+
+def assert_ladder_traceable(cfg: ControlConfig) -> None:
+    """Cheap sanity hook for harness start: every rung must build a valid
+    config (``CompressionConfig.__post_init__`` validates ranges), so a bad
+    ladder fails at launch, not at the first rung switch mid-run."""
+    base = CompressionConfig(method=cfg.method)
+    for i in range(len(cfg.rungs)):
+        comp_for_rung(base, cfg, i)
+    jax.tree.map(lambda x: x, cfg.rungs)  # tuples of plain floats only

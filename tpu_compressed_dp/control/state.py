@@ -1,0 +1,83 @@
+"""The controller's cross-step carry: one more ``TrainState`` occupant.
+
+:class:`ControlState` follows :class:`~tpu_compressed_dp.train.guard.GuardState`
+exactly: replicated device scalars (every worker consumes the identical
+psum'd metrics, so every worker would compute the identical state), threaded
+through the jitted step untouched (the step's ``state_spec`` gives it the
+replicated ``P()`` spec), serialised to Orbax as a plain dict
+(``utils/checkpoint.py``), and therefore bitwise replayable through
+crash/resume.  The jitted step never reads or writes it — the HOST controller
+(:mod:`tpu_compressed_dp.control.controller`) replaces it between steps, which
+is exactly when rung switches are legal anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from tpu_compressed_dp.control.config import ControlConfig
+
+Array = jax.Array
+
+__all__ = ["ControlState", "init_control_state", "control_to_dict",
+           "control_from_dict"]
+
+
+@struct.dataclass
+class ControlState:
+    """Everything a resumed run needs to continue the decision sequence
+    bitwise: the ladder position, the open window's start on the
+    applied-update clock, its accumulators, and the decision-log cursor."""
+
+    rung: Array           # i32 current ladder index (0 = least compressed)
+    window_start: Array   # i32 applied-update count when the window opened
+    win_updates: Array    # i32 applied updates accumulated in the window
+    win_bits: Array       # f32 billed bits accumulated (sum over updates)
+    win_comm_ms: Array    # f32 comm-time signal accumulated, ms
+    win_budget_ms: Array  # f32 hideable-compute budget accumulated, ms
+    decisions: Array      # i32 windows closed so far (the event-log cursor)
+
+
+def init_control_state(cfg: Optional[ControlConfig]) -> Any:
+    """Fresh :class:`ControlState` (``()`` when adaptive control is off,
+    mirroring ``ef``/``comp``/``guard``).
+
+    Each field gets its OWN zero array — sharing one buffer across fields
+    aliases them and breaks the donating jitted step (see
+    :func:`tpu_compressed_dp.train.guard.init_guard_state`).
+    """
+    if cfg is None:
+        return ()
+    return ControlState(
+        rung=jnp.asarray(cfg.start_rung, jnp.int32),
+        window_start=jnp.zeros((), jnp.int32),
+        win_updates=jnp.zeros((), jnp.int32),
+        win_bits=jnp.zeros((), jnp.float32),
+        win_comm_ms=jnp.zeros((), jnp.float32),
+        win_budget_ms=jnp.zeros((), jnp.float32),
+        decisions=jnp.zeros((), jnp.int32),
+    )
+
+
+def control_to_dict(cs: ControlState) -> Dict[str, Array]:
+    """Plain-dict form for Orbax (no pytree-registration agreement needed
+    between the writing and reading process; same idiom as
+    :func:`~tpu_compressed_dp.train.guard.guard_to_dict`)."""
+    return {f.name: getattr(cs, f.name) for f in dataclasses.fields(cs)}
+
+
+def control_from_dict(d: Dict[str, Any]) -> ControlState:
+    return ControlState(
+        rung=jnp.asarray(d["rung"], jnp.int32),
+        window_start=jnp.asarray(d["window_start"], jnp.int32),
+        win_updates=jnp.asarray(d["win_updates"], jnp.int32),
+        win_bits=jnp.asarray(d["win_bits"], jnp.float32),
+        win_comm_ms=jnp.asarray(d["win_comm_ms"], jnp.float32),
+        win_budget_ms=jnp.asarray(d["win_budget_ms"], jnp.float32),
+        decisions=jnp.asarray(d["decisions"], jnp.int32),
+    )

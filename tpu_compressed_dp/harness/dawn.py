@@ -30,10 +30,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_compressed_dp.data import cifar10 as data
-from tpu_compressed_dp.harness.loop import (add_checkpoint_args,
+from tpu_compressed_dp.harness.loop import (add_adaptive_args,
+                                            add_checkpoint_args,
                                             add_robustness_args,
                                             add_telemetry_args,
+                                            build_control,
                                             build_elastic, build_robustness,
+                                            control_summary,
                                             elastic_distributed_init,
                                             make_event_stream, make_heartbeat,
                                             make_preemption, preempt_exit,
@@ -207,6 +210,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     # robustness: shared --guard*/--chaos/--heartbeat surface
     add_robustness_args(p, check_note="checked at epoch end")
+    # adaptive compression: shared --adaptive* surface (control/)
+    add_adaptive_args(p)
     # checkpointing: shared --checkpoint_dir/--resume/--ckpt_every surface
     add_checkpoint_args(p, cadence_help="epochs between async checkpoint "
                                         "saves (requires --checkpoint_dir; "
@@ -278,6 +283,12 @@ def run(args) -> dict:
         raise ValueError(
             f"--method {args.method} requires --compress layerwise|entiremodel "
             "(the reference silently trained dense here; we refuse instead)"
+        )
+    if getattr(args, "adaptive", False) and args.ratio_warmup_epochs > 0:
+        raise ValueError(
+            "--adaptive and --ratio_warmup_epochs both drive the keep-ratio; "
+            "pick one (the controller's rung 0 is the static baseline, so "
+            "adaptive runs start dense-ish on their own ladder)"
         )
     rejoin = elastic_distributed_init(args)
     mesh = make_data_mesh(args.devices)
@@ -380,12 +391,15 @@ def run(args) -> dict:
             method=comp.method)
 
     guard_cfg, chaos, crash = build_robustness(args, jnp.dtype(args.dtype))
+    ctrl_cfg = build_control(args, comp)
+    from tpu_compressed_dp.control import init_control_state
 
     state = TrainState.create(
         params, stats, opt.init(params), init_ef_state(params, comp, ndev),
         jax.random.key(args.seed + 1),
         comp=init_comp_state(params, comp, ndev),
         guard=init_guard_state(guard_cfg),
+        control=init_control_state(ctrl_cfg),
     )
     apply_fn = make_normalizing_apply_fn(
         module,
@@ -395,14 +409,19 @@ def run(args) -> dict:
 
     step_cache: dict = {}
 
-    def train_step_for(ratio: float):
-        if ratio not in step_cache:
-            step_cache[ratio] = make_train_step(
-                apply_fn, opt, comp_for_ratio(ratio), mesh,
+    def train_step_for(comp_cfg: CompressionConfig):
+        # keyed by the tunable knobs: everything else in comp_cfg is fixed
+        # for the run, and (ratio, rank) is exactly what the warm-up
+        # schedule and the adaptive controller's rung ladder vary — one
+        # compile per visited rung, switches only at epoch boundaries
+        key = (comp_cfg.ratio, comp_cfg.rank)
+        if key not in step_cache:
+            step_cache[key] = make_train_step(
+                apply_fn, opt, comp_cfg, mesh,
                 grad_scale=float(bs), clip_norm=args.clip_norm,
                 clip_sent_norm=args.clip_sent_norm,
                 guard_cfg=guard_cfg, chaos=chaos)
-        return step_cache[ratio]
+        return step_cache[key]
 
     eval_step = make_eval_step(apply_fn, mesh)
 
@@ -459,6 +478,24 @@ def run(args) -> dict:
         mesh, ndev = el.mesh, el.world
         step_cache.clear()
         eval_step = make_eval_step(apply_fn, mesh)
+    controller = None
+    hide_frac = 1.0
+    if ctrl_cfg is not None:
+        from tpu_compressed_dp.control import Controller, comp_for_rung
+        from tpu_compressed_dp.parallel.overlap import (hideable_byte_fraction,
+                                                        plan_chunks)
+        from tpu_compressed_dp.train.guard import schedule_step
+
+        controller = Controller(ctrl_cfg, events=events)
+        # the overlap schedule's hideable byte fraction scales the measured
+        # compute into the per-update budget (signals.hideable_budget_ms);
+        # ignored when --adaptive_budget_ms pins the budget
+        hide_frac = hideable_byte_fraction(plan_chunks(
+            [leaf.size * 4 for leaf in jax.tree_util.tree_leaves(params)],
+            comp))
+        print(f"adaptive: method={ctrl_cfg.method} knob={controller.knob} "
+              f"rungs={ctrl_cfg.rungs} window={ctrl_cfg.window} "
+              f"signal={ctrl_cfg.signal} hideable_frac={hide_frac:.3f}")
     # Per-chip forward FLOPs from XLA's cost model, once (the epoch loop
     # scales it by the measured step rate — utils/flops.py conventions:
     # train = 3x fwd, MFU vs the chip's bf16 peak, omitted off-TPU).  The
@@ -486,7 +523,12 @@ def run(args) -> dict:
             # the run before the next epoch compiles/dispatches anything
             preempt.check(int(state.step))
             profiling = args.profile_epoch == epoch and args.log_dir
-            train_step = train_step_for(ratio_for_epoch(epoch))
+            # adaptive: the checkpointed rung picks the (trace-cached) step
+            # variant; otherwise the DGC warm-up schedule picks the ratio
+            train_step = train_step_for(
+                comp_for_rung(comp, ctrl_cfg, int(state.control.rung))
+                if controller is not None
+                else comp_for_ratio(ratio_for_epoch(epoch)))
             try:
                 with profile_trace(
                         os.path.join(args.log_dir, "profile") if profiling else None):
@@ -532,6 +574,37 @@ def run(args) -> dict:
                     from tpu_compressed_dp.train.elastic import TrimBatches
                     cur_train = TrimBatches(train_batches, cur_bs)
                     cur_test = TrimBatches(test_batches, cur_bs)
+            if controller is not None:
+                # decisions key off APPLIED updates (guard skips excluded),
+                # and the tick lands BEFORE the epoch checkpoint: the saved
+                # ControlState already contains this epoch's accumulation,
+                # so a crash-relaunch replays the remaining windows bitwise
+                # instead of losing this epoch's contribution
+                applied = (schedule_step(guard_cfg, state.guard,
+                                         int(state.step))
+                           if guard_cfg is not None else int(state.step))
+                wall_ms = (epoch_stats["train time"] * 1e3
+                           / max(acc.steps, 1))
+                old_rung = int(state.control.rung)
+                new_control, _ = controller.tick(
+                    state.control, applied=applied,
+                    signals=controller.window_signals(
+                        mean_bits=acc.mean("comm/sent_bits"),
+                        measured_comm_ms=wall_ms,
+                        compute_ms=wall_ms,
+                        hideable_fraction=hide_frac))
+                state = state.replace(control=new_control)
+                new_rung = int(new_control.rung)
+                if new_rung != old_rung and controller.knob == "rank":
+                    # PowerSGD rank switch: re-seat the warm q columns at
+                    # the new rank so the next rung's step variant starts
+                    # from the learnt subspace, not a cold re-init
+                    from tpu_compressed_dp.control import (comp_for_rung,
+                                                           migrate_comp_state)
+                    state = state.replace(comp=migrate_comp_state(
+                        state.comp, params,
+                        comp_for_rung(comp, ctrl_cfg, old_rung),
+                        comp_for_rung(comp, ctrl_cfg, new_rung), ndev))
             if (ckpt is not None and args.ckpt_every > 0
                     and (epoch + 1) % args.ckpt_every == 0):
                 # async: snapshot to host and return — the write overlaps
@@ -555,6 +628,8 @@ def run(args) -> dict:
                     telemetry=telemetry_snapshot(timeline),
                     **(ckpt.heartbeat_fields() if ckpt is not None else {}),
                     **({"elastic": el.metrics()} if el is not None else {}),
+                    **(controller.heartbeat_fields(state.control)
+                       if controller is not None else {}),
                 )
             summary = {
                 "epoch": epoch + 1,
@@ -565,16 +640,20 @@ def run(args) -> dict:
             }
             if "throughput/mfu" in thr:
                 summary["mfu"] = round(thr["throughput/mfu"], 4)
+            summary.update(control_summary(controller, state.control))
             guard_last = {k: v for k, v in acc.last.items()
                           if k.startswith("guard/")}
             comm_means = {k: acc.mean(k) for k in acc.sums
                           if k.startswith("comm/")}
+            control_stats = (controller.metrics(state.control)
+                             if controller is not None else {})
             if events is not None:
                 events.emit(
                     "epoch", epoch=epoch + 1, step=int(state.step),
                     metrics={k: v for k, v in summary.items()
                              if isinstance(v, (int, float))},
                     throughput=thr, comm=comm_means, guard=guard_last,
+                    control=control_stats,
                     timeline=timeline.snapshot(),
                     step_spans=timeline.drain())
                 skipped = guard_last.get("guard/skipped", 0.0)
@@ -585,7 +664,7 @@ def run(args) -> dict:
             if args.prom and rank0:
                 write_prometheus(
                     {"loss": summary["train loss"], "lr": summary["lr"],
-                     **thr, **comm_means, **guard_last,
+                     **thr, **comm_means, **guard_last, **control_stats,
                      **timeline.snapshot(),
                      **(ckpt.metrics() if ckpt is not None else {}),
                      **(el.metrics() if el is not None else {})},

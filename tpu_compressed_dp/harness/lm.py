@@ -114,10 +114,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "replication signature, interleaved with backward "
                         "compute; numerics unchanged (1 = single dispatch)")
     # robustness: shared --guard*/--chaos/--heartbeat surface
-    from tpu_compressed_dp.harness.loop import (add_robustness_args,
+    from tpu_compressed_dp.harness.loop import (add_adaptive_args,
+                                                add_robustness_args,
                                                 add_telemetry_args)
 
     add_robustness_args(p, check_note="checked every --log_every")
+    # adaptive compression: shared --adaptive* surface (control/); the LM
+    # loop's decision cadence is the --log_every metric-fetch window
+    add_adaptive_args(p)
     # telemetry: shared --events/--prom surface (obs/export.py)
     add_telemetry_args(p)
     p.add_argument("--logdir", type=str, default=None,
@@ -213,10 +217,46 @@ def run(args) -> Dict[str, float]:
         error_feedback=args.error_feedback,
         sync_overlap=args.overlap,
     )
-    from tpu_compressed_dp.harness.loop import build_robustness
+    from tpu_compressed_dp.harness.loop import build_control, build_robustness
     from tpu_compressed_dp.train.guard import init_guard_state
 
     guard_cfg, chaos, crash = build_robustness(args, cfg.dtype)
+    ctrl_cfg = build_control(args, comp)
+    if ctrl_cfg is not None and pipelined:
+        raise ValueError(
+            "--adaptive supports the (data, seq, tensor) step; the pipeline "
+            "step's stacked-layer layout has no rung-switch path yet")
+    if ctrl_cfg is not None:
+        from tpu_compressed_dp.control.rungs import ladder_knob
+        if ladder_knob(ctrl_cfg.method) == "rank":
+            raise ValueError(
+                "--adaptive rank retuning (powersgd) is CNN-harness-only "
+                "for now: the LM comp-state layout has no cross-rank "
+                "migration path (use a ratio method, or static --rank)")
+    from tpu_compressed_dp.control import init_control_state
+
+    step_cache: Dict = {}
+
+    def active_comp() -> CompressionConfig:
+        """The compression config the NEXT step should trace under: the
+        controller's checkpointed rung when adaptive, the static config
+        otherwise."""
+        if ctrl_cfg is None:
+            return comp
+        from tpu_compressed_dp.control import comp_for_rung
+        return comp_for_rung(comp, ctrl_cfg, int(state.control.rung))
+
+    def lm_step_for(comp_cfg: CompressionConfig):
+        # keyed by the tunable knobs (the rung ladder varies exactly these);
+        # cleared wholesale on remesh — entries close over the current mesh
+        key = (comp_cfg.ratio, comp_cfg.rank)
+        if key not in step_cache:
+            step_cache[key] = make_lm_train_step(
+                cfg, opt, comp_cfg, mesh,
+                clip_norm=args.clip_norm,
+                clip_sent_norm=args.clip_sent_norm,
+                guard_cfg=guard_cfg, chaos=chaos)
+        return step_cache[key]
     if pipelined:
         # NB make_pp_train_step rejects method='powersgd' (stacked-layer
         # params shard over pipe; no warm-start init exists for that layout)
@@ -253,6 +293,7 @@ def run(args) -> Dict[str, float]:
             jax.random.key(args.seed + 1),
             comp=init_lm_comp_state(cfg, params, comp, mesh),
             guard=init_guard_state(guard_cfg),
+            control=init_control_state(ctrl_cfg),
         )
         ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
         if args.resume:
@@ -264,10 +305,7 @@ def run(args) -> Dict[str, float]:
             state = place_lm_state(state, cfg, comp, mesh)
             print(f"resumed step {int(state.step)}")
 
-        train_step = make_lm_train_step(cfg, opt, comp, mesh,
-                                        clip_norm=args.clip_norm,
-                                        clip_sent_norm=args.clip_sent_norm,
-                                        guard_cfg=guard_cfg, chaos=chaos)
+        train_step = lm_step_for(active_comp())
     mesh_str = (f"dp{dp}xsp{args.sp}xpp{args.pp}xtp{args.tp}(mb{args.microbatches})" if pipelined
                 else f"dp{dp}xsp{args.sp}xtp{args.tp}")
     print(f"params={n_params/1e6:.1f}M mesh={mesh_str} "
@@ -317,10 +355,22 @@ def run(args) -> Dict[str, float]:
         state = el.join_world(state, rejoin)
         mesh = el.mesh
         dp = el.world
-        train_step = make_lm_train_step(cfg, opt, comp, mesh,
-                                        clip_norm=args.clip_norm,
-                                        clip_sent_norm=args.clip_sent_norm,
-                                        guard_cfg=guard_cfg, chaos=chaos)
+        step_cache.clear()
+        train_step = lm_step_for(active_comp())
+    controller = None
+    hide_frac = 1.0
+    if ctrl_cfg is not None:
+        from tpu_compressed_dp.control import Controller
+        from tpu_compressed_dp.parallel.overlap import (hideable_byte_fraction,
+                                                        plan_chunks)
+        from tpu_compressed_dp.train.guard import schedule_step
+
+        controller = Controller(ctrl_cfg, events=events)
+        hide_frac = hideable_byte_fraction(plan_chunks(
+            [leaf.size * 4 for leaf in jax.tree.leaves(params)], comp))
+        print(f"adaptive: method={ctrl_cfg.method} knob={controller.knob} "
+              f"rungs={ctrl_cfg.rungs} window={ctrl_cfg.window} "
+              f"signal={ctrl_cfg.signal} hideable_frac={hide_frac:.3f}")
     # --profile_epoch: trace the Nth log window.  ExitStack (not a `with`)
     # because the window opens and closes mid-loop; the outer finally
     # guarantees the stop even when the loop raises inside the window —
@@ -398,6 +448,8 @@ def run(args) -> Dict[str, float]:
                             **(ckpt.heartbeat_fields() if ckpt is not None
                                else {}),
                             **({"elastic": el.metrics()} if el is not None else {}),
+                            **(controller.heartbeat_fields(state.control)
+                               if controller is not None else {}),
                         )
                     steps_timed = step_i + 1 - timed_from
                     tokens_done = steps_timed * rows * args.seq_len
@@ -444,12 +496,45 @@ def run(args) -> Dict[str, float]:
                         gsum = guard_meter.summary()
                         summary["skipped"] = gsum.get("guard/skipped", 0.0)
                         summary["loss_scale"] = gsum.get("guard/loss_scale", 1.0)
+                    control_stats: Dict[str, float] = {}
+                    if controller is not None:
+                        # decision tick at the log-window cadence, keyed to
+                        # APPLIED updates; ticks before the checkpoint-save
+                        # site below so the saved ControlState carries this
+                        # window's accumulation (bitwise crash/resume)
+                        applied = (schedule_step(guard_cfg, state.guard,
+                                                 int(state.step))
+                                   if guard_cfg is not None
+                                   else int(state.step))
+                        wall_ms = (dt * 1e3 / steps_timed
+                                   if steps_timed > 0 else None)
+                        if wall_ms is not None or (
+                                ctrl_cfg.signal == "modeled"
+                                and ctrl_cfg.budget_ms > 0):
+                            old_rung = int(state.control.rung)
+                            new_control, _ = controller.tick(
+                                state.control, applied=applied,
+                                signals=controller.window_signals(
+                                    mean_bits=float(
+                                        m.get("comm/sent_bits", 0.0)),
+                                    measured_comm_ms=wall_ms,
+                                    compute_ms=wall_ms,
+                                    hideable_fraction=hide_frac))
+                            state = state.replace(control=new_control)
+                            if int(new_control.rung) != old_rung:
+                                # trace-cached rung switch: takes effect at
+                                # the next step dispatch
+                                train_step = lm_step_for(active_comp())
+                        control_stats = controller.metrics(state.control)
+                        summary["rung"] = control_stats["control/rung"]
+                        summary[controller.knob] = control_stats["control/value"]
                     if events is not None:
                         events.emit(
                             "step", step=step_i + 1,
                             metrics={k: v for k, v in summary.items()
                                      if isinstance(v, (int, float))},
                             throughput=thr, comm=comm_m, guard=guard_last,
+                            control=control_stats,
                             timeline=timeline.snapshot(),
                             step_spans=timeline.drain())
                         # delta-gate on the cumulative counter: one guard event
@@ -462,7 +547,7 @@ def run(args) -> Dict[str, float]:
                     if args.prom and jax.process_index() == 0:
                         write_prometheus(
                             {"loss": summary["loss"], "lr": summary["lr"],
-                             **thr, **comm_m, **guard_last,
+                             **thr, **comm_m, **guard_last, **control_stats,
                              **timeline.snapshot(),
                              **(ckpt.metrics() if ckpt is not None else {}),
                              **(el.metrics() if el is not None else {})},
@@ -481,11 +566,8 @@ def run(args) -> Dict[str, float]:
                         dp = el.world
                         world = dp * args.sp
                         rows = (args.global_batch // dp) * dp
-                        train_step = make_lm_train_step(
-                            cfg, opt, comp, mesh,
-                            clip_norm=args.clip_norm,
-                            clip_sent_norm=args.clip_sent_norm,
-                            guard_cfg=guard_cfg, chaos=chaos)
+                        step_cache.clear()
+                        train_step = lm_step_for(active_comp())
                         warm_until = step_i + 2  # compile pair on the new mesh
                         t0 = time.time()
                         timed_from = step_i + 1
@@ -506,11 +588,8 @@ def run(args) -> Dict[str, float]:
                 dp = el.world
                 world = dp * args.sp
                 rows = (args.global_batch // dp) * dp
-                train_step = make_lm_train_step(
-                    cfg, opt, comp, mesh,
-                    clip_norm=args.clip_norm,
-                    clip_sent_norm=args.clip_sent_norm,
-                    guard_cfg=guard_cfg, chaos=chaos)
+                step_cache.clear()
+                train_step = lm_step_for(active_comp())
                 warm_until = step_i + 1     # fresh compile pair on the new mesh
                 t0 = time.time()
                 timed_from = step_i

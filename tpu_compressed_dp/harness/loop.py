@@ -20,11 +20,12 @@ from tpu_compressed_dp.utils.loggers import MetricAccumulator
 from tpu_compressed_dp.utils.timer import Timer
 
 __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
-           "comm_summary", "guard_summary", "add_robustness_args",
+           "comm_summary", "guard_summary", "control_summary",
+           "add_robustness_args", "add_adaptive_args",
            "add_telemetry_args", "add_checkpoint_args", "build_robustness",
-           "build_elastic", "elastic_distributed_init", "make_heartbeat",
-           "make_event_stream", "make_preemption", "preempt_exit",
-           "profile_trace"]
+           "build_control", "build_elastic", "elastic_distributed_init",
+           "make_heartbeat", "make_event_stream", "make_preemption",
+           "preempt_exit", "profile_trace"]
 
 
 @contextlib.contextmanager
@@ -114,6 +115,85 @@ def add_robustness_args(p, *, check_note: str) -> None:
                         "in elastic/dropped_ef_norm")
     p.add_argument("--elastic_min_world", type=int, default=2,
                    help="refuse to remesh below this many workers")
+
+
+def add_adaptive_args(p) -> None:
+    """The shared ``--adaptive*`` CLI surface: the closed-loop compression
+    controller (tpu_compressed_dp/control/).  Decision cadence is the
+    harness's metric-fetch window (epoch for CIFAR/ImageNet, log window for
+    the LM harness) — the controller's own ``--adaptive_window`` counts
+    APPLIED updates inside those fetches."""
+    p.add_argument("--adaptive", action="store_true",
+                   help="arm the closed-loop compression controller: retune "
+                        "the compression knob (Top-K/Random-K ratio, "
+                        "PowerSGD rank) along a precompiled rung ladder to "
+                        "fit comm under the hideable-compute budget "
+                        "(control/controller.py)")
+    p.add_argument("--adaptive_window", type=int, default=8,
+                   help="applied updates per control decision window")
+    p.add_argument("--adaptive_deadband", type=float, default=0.25,
+                   help="relative comm/budget deadband before a rung move")
+    p.add_argument("--adaptive_rungs", type=str, default=None,
+                   help="comma-separated explicit rung ladder (strictly "
+                        "descending knob values; rung 0 is the static "
+                        "baseline).  Default: halve the configured "
+                        "ratio/rank per rung, 5 rungs deep")
+    p.add_argument("--adaptive_budget_ms", type=float, default=0.0,
+                   help="explicit per-update hideable-comm budget in ms; "
+                        "0 = derive from measured compute x the overlap "
+                        "schedule's hideable byte fraction")
+    p.add_argument("--adaptive_bw_mbps", type=float, default=100.0,
+                   help="modeled interconnect bandwidth (Mbit/s) used to "
+                        "turn analytic sent-bits into comm ms under "
+                        "--adaptive_signal modeled")
+    p.add_argument("--adaptive_signal", type=str, default="modeled",
+                   choices=("modeled", "measured"),
+                   help="'modeled' prices comm from analytic sent-bits / "
+                        "--adaptive_bw_mbps (bitwise replay-deterministic); "
+                        "'measured' uses harness-observed wall times "
+                        "(NOT replay-deterministic)")
+
+
+def build_control(args, comp_cfg):
+    """Resolve the ``--adaptive*`` CLI surface into a
+    :class:`~tpu_compressed_dp.control.ControlConfig` (or None).
+
+    Raises on a non-tunable compression method — silently running static
+    under an --adaptive flag would invalidate any adaptive-vs-static
+    comparison the run was launched for."""
+    if not getattr(args, "adaptive", False):
+        return None
+    from tpu_compressed_dp.control import ControlConfig, build_ladder
+    from tpu_compressed_dp.control.config import TUNABLE_METHODS
+    from tpu_compressed_dp.control.rungs import ladder_knob
+    from tpu_compressed_dp.ops.compressors import canonical_name
+
+    method = (canonical_name(comp_cfg.method)
+              if comp_cfg is not None and comp_cfg.method else None)
+    if method not in TUNABLE_METHODS:
+        raise SystemExit(
+            f"--adaptive requires a tunable compression method "
+            f"{TUNABLE_METHODS}, got {method!r}")
+    if args.adaptive_rungs:
+        knob = ladder_knob(method)
+        cast = float if knob == "ratio" else int
+        rungs = tuple(cast(v) for v in args.adaptive_rungs.split(","))
+    else:
+        rungs = build_ladder(method, comp_cfg.ratio, comp_cfg.rank)
+    return ControlConfig(
+        method=method, rungs=rungs,
+        window=args.adaptive_window, deadband=args.adaptive_deadband,
+        signal=args.adaptive_signal, bandwidth_mbps=args.adaptive_bw_mbps,
+        budget_ms=args.adaptive_budget_ms)
+
+
+def control_summary(controller, control) -> Dict[str, float]:
+    """Epoch adaptive-control accounting for the harness summary line:
+    the live rung index and knob value.  Empty when the controller is off."""
+    if controller is None or control == ():
+        return {}
+    m = controller.metrics(control)
+    return {"rung": m["control/rung"], controller.knob: m["control/value"]}
 
 
 def make_heartbeat(args):

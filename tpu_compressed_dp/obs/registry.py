@@ -207,6 +207,23 @@ declare("ckpt/rollback_steps", COUNTER, "steps", "max", "host",
         "steps walked back past corrupt/unreadable checkpoints to reach "
         "the newest verifiable one at restore time")
 
+# --- adaptive compression control plane (control/; host-side — every
+#     worker's controller consumes identical psum'd metrics, so values are
+#     identical across workers) -------------------------------------------
+declare("control/rung", GAUGE, "index", "mean", "host",
+        "current compression-ladder position (0 = least compressed)")
+declare("control/value", GAUGE, "knob", "mean", "host",
+        "active rung's knob value (keep ratio, or PowerSGD rank)")
+declare("control/decisions", COUNTER, "windows", "max", "host",
+        "decision windows closed so far (the control_decision event cursor)")
+declare("control/window_updates", GAUGE, "updates", "mean", "host",
+        "applied updates accumulated in the open decision window")
+declare("control/comm_ms", TIMING, "ms", "mean", "host",
+        "open window's mean per-update comm-time signal (modeled: billed "
+        "bits over configured bandwidth; measured: timeline)")
+declare("control/budget_ms", TIMING, "ms", "mean", "host",
+        "open window's mean per-update hideable-compute budget")
+
 
 def canonical(key: str) -> str:
     """Map a raw engine stat key to its canonical registry name.

@@ -65,7 +65,8 @@ import jax.numpy as jnp
 from tpu_compressed_dp.obs import trace as obs_trace
 
 __all__ = ["ChunkPlan", "plan_chunks", "grad_availability", "issue_order",
-           "make_chunked_grad_sync", "make_overlap_sync_apply"]
+           "make_chunked_grad_sync", "make_overlap_sync_apply",
+           "hideable_byte_fraction"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,6 +131,27 @@ def plan_chunks(byte_sizes: Sequence[int], cfg) -> List[ChunkPlan]:
         leaf_lo = leaf_hi
     assert gi == len(groups) and leaf_lo == len(byte_sizes)
     return plans
+
+
+def hideable_byte_fraction(plans: Sequence[ChunkPlan]) -> float:
+    """Fraction of the sync's bytes the chunk schedule can bury under
+    remaining compute — the adaptive controller's budget scaler
+    (:func:`tpu_compressed_dp.control.signals.hideable_budget_ms`).
+
+    Chunks issue in reverse-parameter order; the LAST-issued chunk (chunk 0,
+    the first parameters) completes at the head of the optimizer tail with
+    the least compute left to hide behind, so its bytes are counted exposed
+    and everything else hideable.  A single-chunk plan (``sync_overlap=1``,
+    or entiremodel granularity) therefore yields 0.0 — nothing pipelines,
+    matching the one-late-all-reduce behaviour the overlap evidence
+    measured.
+    """
+    plans = list(plans)
+    total = float(sum(p.n_bytes for p in plans))
+    if total <= 0.0 or len(plans) < 2:
+        return 0.0
+    exposed = float(min(plans, key=lambda p: p.index).n_bytes)
+    return max(0.0, 1.0 - exposed / total)
 
 
 def _comp_slice(comp: Any, plan: ChunkPlan) -> Any:

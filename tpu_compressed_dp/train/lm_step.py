@@ -138,6 +138,8 @@ def lm_state_specs(cfg: LlamaConfig, comp: CompressionConfig) -> TrainState:
         # step-guard state: replicated (the finiteness vote makes it
         # identical on every worker)
         guard=P(),
+        # adaptive-compression control state: replicated, host-mutated only
+        control=P(),
     )
 
 

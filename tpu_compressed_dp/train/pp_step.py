@@ -174,6 +174,8 @@ def pp_state_specs(cfg: LlamaConfig, comp: CompressionConfig,
         comp=P(worker_ax),
         # step-guard state: replicated (global finiteness vote)
         guard=P(),
+        # adaptive-compression control state: replicated, host-mutated only
+        control=P(),
     )
 
 

@@ -13,6 +13,10 @@ power iteration's converged subspace instead of re-warming from random.
 cross-worker finiteness vote makes every field identical on every worker —
 and checkpointed, so a restored run resumes with the loss scale it had
 found, not the (possibly overflowing) init.
+``control`` is the adaptive-compression controller's carry (current rung,
+open decision window, :func:`tpu_compressed_dp.control.state.init_control_state`):
+replicated and checkpointed like ``guard``, but mutated only by the HOST
+controller between steps — the jitted step threads it through untouched.
 """
 
 from __future__ import annotations
@@ -38,10 +42,12 @@ class TrainState:
     rng: jax.Array             # base PRNG key; per-step keys are folded from it
     comp: Any = ()             # compressor state (PowerSGD warm-start Q), or ()
     guard: Any = ()            # step-guard state (GuardState), or () when off
+    control: Any = ()          # adaptive-compression state (ControlState), or ()
 
     @classmethod
     def create(cls, params: Any, batch_stats: Any, opt_state: Any, ef: Any,
-               rng: jax.Array, comp: Any = (), guard: Any = ()):
+               rng: jax.Array, comp: Any = (), guard: Any = (),
+               control: Any = ()):
         return cls(
             step=jnp.asarray(0, jnp.int32),
             params=params,
@@ -51,6 +57,7 @@ class TrainState:
             rng=rng,
             comp=comp,
             guard=guard,
+            control=control,
         )
 
     def with_mesh_sharding(self, mesh: Mesh, axis_name: str = "data") -> "TrainState":
@@ -82,7 +89,7 @@ class TrainState:
         placed = {}
         for f in dataclasses.fields(self):
             val, spec = getattr(self, f.name), getattr(specs, f.name)
-            if f.name in ("ef", "comp", "guard") and val == ():
+            if f.name in ("ef", "comp", "guard", "control") and val == ():
                 placed[f.name] = ()
             elif isinstance(spec, P):
                 placed[f.name] = jax.tree.map(lambda v: place(v, spec), val)

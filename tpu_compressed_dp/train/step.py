@@ -273,7 +273,7 @@ def make_train_step(
 
     state_spec = TrainState(
         step=P(), params=P(), batch_stats=P(), opt_state=P(), ef=P(axis_name),
-        rng=P(), comp=P(axis_name), guard=P(),
+        rng=P(), comp=P(axis_name), guard=P(), control=P(),
     )
     sharded = shard_map(
         local_step,
@@ -349,7 +349,7 @@ def make_eval_step(apply_fn: ApplyFn, mesh: Mesh, *, axis_name: str = "data"):
 
     state_spec = TrainState(
         step=P(), params=P(), batch_stats=P(), opt_state=P(), ef=P(axis_name),
-        rng=P(), comp=P(axis_name), guard=P(),
+        rng=P(), comp=P(axis_name), guard=P(), control=P(),
     )
     sharded = shard_map(
         local_eval,

@@ -474,8 +474,9 @@ class Checkpointer:
             except (ValueError, KeyError) as e:
                 # The template can legitimately disagree with the saved tree
                 # on the OPTIONAL state entries: legacy checkpoints lack
-                # 'comp' (pre-PowerSGD) and/or 'guard' (pre-step-guard)
-                # entirely, and toggling powersgd / --guard between save and
+                # 'comp' (pre-PowerSGD), 'guard' (pre-step-guard) and/or
+                # 'control' (pre-adaptive-compression) entirely, and toggling
+                # powersgd / --guard / --adaptive between save and
                 # resume flips those entries between the empty marker {} and
                 # {'on': ...} (Orbax raises ValueError for
                 # template-missing-saved-key and KeyError for
@@ -501,7 +502,7 @@ class Checkpointer:
                 if set(saved) - set(template):
                     raise e  # fields this build does not know — not legacy
                 for k, tv in template.items():
-                    if k in ("guard", "comp"):
+                    if k in ("guard", "comp", "control"):
                         continue
                     if k not in saved:
                         raise e
@@ -576,32 +577,38 @@ class Checkpointer:
 
 
 def _to_saveable(state: TrainState) -> Dict[str, Any]:
+    from tpu_compressed_dp.control.state import control_to_dict
     from tpu_compressed_dp.train.guard import guard_to_dict
 
     d = {f.name: getattr(state, f.name) for f in dataclasses.fields(state)}
     # PRNG keys: store raw key data (typed keys are not serialisable)
     d["rng"] = jax.random.key_data(d["rng"])
-    # ef/comp/guard == () when off; Orbax cannot round-trip an empty
-    # container leaf.  GuardState serialises as a plain dict so the on-disk
-    # form needs no pytree registration agreement with a future reader.
+    # ef/comp/guard/control == () when off; Orbax cannot round-trip an empty
+    # container leaf.  GuardState/ControlState serialise as plain dicts so
+    # the on-disk form needs no pytree registration agreement with a future
+    # reader.
     d["ef"] = {"on": d["ef"]} if d["ef"] != () else {}
     d["comp"] = {"on": d["comp"]} if d["comp"] != () else {}
     d["guard"] = {"on": guard_to_dict(d["guard"])} if d["guard"] != () else {}
+    d["control"] = ({"on": control_to_dict(d["control"])}
+                    if d["control"] != () else {})
     return d
 
 
 def _from_saveable(target: TrainState, d: Dict[str, Any]) -> TrainState:
+    from tpu_compressed_dp.control.state import control_from_dict
     from tpu_compressed_dp.train.guard import guard_from_dict
 
     d = dict(d)
     d["rng"] = jax.random.wrap_key_data(np.asarray(d["rng"]))
     ef = d["ef"]
     d["ef"] = ef["on"] if "on" in ef else ()
-    # comp/guard: a saved value wins; the empty marker {} (feature was OFF
-    # at save time) or a missing key (checkpoint predates the field) keeps
-    # the CALLER's value — a freshly-built warm start / init_guard_state
-    # when resuming an old run with powersgd / the guard newly enabled,
-    # () otherwise — instead of clobbering it.
+    # comp/guard/control: a saved value wins; the empty marker {} (feature
+    # was OFF at save time) or a missing key (checkpoint predates the field)
+    # keeps the CALLER's value — a freshly-built warm start /
+    # init_guard_state / init_control_state when resuming an old run with
+    # powersgd / the guard / adaptive control newly enabled, () otherwise —
+    # instead of clobbering it.
     if "comp" in d and "on" in d["comp"]:
         d["comp"] = d["comp"]["on"]
     else:
@@ -610,6 +617,11 @@ def _from_saveable(target: TrainState, d: Dict[str, Any]) -> TrainState:
         d["guard"] = guard_from_dict(d["guard"]["on"])
     else:
         d["guard"] = target.guard
+    if "control" in d and isinstance(d["control"], dict) \
+            and "on" in d["control"]:
+        d["control"] = control_from_dict(d["control"]["on"])
+    else:
+        d["control"] = target.control
     return dataclasses.replace(target, **d)
 
 
