@@ -38,6 +38,13 @@ def test_quick_drill(mesh8):
     # the same rung schedule and the same control_decision events
     assert results["control_resume"]["rungs"] == [1, 2, 2]
     assert results["control_resume"]["resumed_mid_window"] is True
+    # ISSUE 12 acceptance row: high-priority arrival evicts one job and
+    # shrinks another through the readmit barrier; every job finishes
+    # bitwise-equal to its solo run
+    assert results["fleet"]["evictions"] == 1
+    assert results["fleet"]["shrinks"] == 1
+    assert results["fleet"]["readmits"] == 1
+    assert results["fleet"]["bitwise"] is True
 
 
 @pytest.mark.quick
@@ -47,7 +54,7 @@ def test_every_quick_row_registered_and_collectible(capsys):
     quick/slow row matrix — so a row can neither silently vanish from the
     tier-1 gate nor run unlisted."""
     # matrix groups expand inline; aliased rows re-parameterise another drill
-    matrix = ("skip_matrix", "elastic_matrix")
+    matrix = ("skip_matrix", "elastic_matrix", "fleet_matrix")
     alias = {"ef_identity_sharded": "ef_identity"}
 
     def resolves(name):
@@ -99,6 +106,15 @@ def test_full_drill_matrix(mesh8):
     assert results["elastic[sharded-wire]"]["world"] == 7
     # cascade: during_remesh second death -> one committed remesh at W-2
     assert results["elastic_cascade"] == {"world": 6, "cascades": 1}
+    # fleet matrix: both EF policies shrink+readmit bitwise; the rigid
+    # cell has no shrink candidate, so preemption is evict-only
+    for cell in ("fleet[fold]", "fleet[drop]"):
+        assert results[cell]["bitwise"] is True
+        assert (results[cell]["shrinks"], results[cell]["readmits"]) == (1, 1)
+    assert results["fleet[rigid]"]["bitwise"] is True
+    assert (results["fleet[rigid]"]["shrinks"],
+            results["fleet[rigid]"]["readmits"],
+            results["fleet[rigid]"]["evictions"]) == (0, 0, 1)
 
 
 @pytest.mark.slow

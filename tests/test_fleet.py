@@ -1,0 +1,646 @@
+"""Fleet control plane: spec validation, the pure planner, the shared-dir
+protocol under torn reads and concurrent writers, the scheduler's
+preemption interleavings against a scripted controller, the shared
+supervised-spawn environment composition, and the tools/fleet.py CLI."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from tpu_compressed_dp.fleet import (DevicePool, Evict, FleetScheduler, Grow,
+                                     JobController, JobSpec, Place, Shrink,
+                                     Slot, SpecError, Waiting, plan)
+from tpu_compressed_dp.fleet import state as fstate
+from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT, spawn_supervised
+
+
+def _spec(job_id="j", command=("run",), **kw):
+    return JobSpec(job_id, command, **kw)
+
+
+@pytest.mark.quick
+class TestJobSpec:
+    def test_roundtrip(self):
+        s = _spec("lm-a", ("python", "-m", "x"), priority=2, min_world=2,
+                  max_world=4, target_updates=100, checkpoint_dir="ck")
+        assert JobSpec.from_json(s.to_json()) == s
+        assert JobSpec.parse(json.dumps(s.to_json())) == s
+        assert s.elastic
+
+    def test_pinned_world_is_not_elastic(self):
+        assert not _spec(min_world=3, max_world=3).elastic
+
+    def test_bad_job_ids_rejected(self):
+        for bad in ("", "a/b", ".hidden", "a b", "x" * 65, "spéc"):
+            with pytest.raises(SpecError):
+                _spec(job_id=bad)
+
+    def test_empty_command_rejected(self):
+        with pytest.raises(SpecError):
+            _spec(command=())
+
+    def test_world_range_validated(self):
+        with pytest.raises(SpecError):
+            _spec(min_world=0)
+        with pytest.raises(SpecError):
+            _spec(min_world=3, max_world=2)
+
+    def test_target_updates_validated(self):
+        with pytest.raises(SpecError):
+            _spec(target_updates=0)
+
+    def test_unknown_fields_rejected(self):
+        with pytest.raises(SpecError, match="unknown"):
+            JobSpec.from_json({"job_id": "j", "command": ["run"],
+                               "prio": 3})
+
+    def test_command_must_be_argv_list(self):
+        with pytest.raises(SpecError, match="argv"):
+            JobSpec.from_json({"job_id": "j", "command": "python -m x"})
+
+    def test_parse_rejects_non_json(self):
+        with pytest.raises(SpecError, match="JSON"):
+            JobSpec.parse("{not json")
+
+    def test_command_coerced_to_strings(self):
+        assert _spec(command=("python", 3)).command == ("python", "3")
+
+
+@pytest.mark.quick
+class TestPlan:
+    def _slot(self, job_id, world, *, priority=0, min_world=None,
+              max_world=None, seq=0, elastic=True):
+        return Slot(job_id, priority, world,
+                    min_world if min_world is not None else world,
+                    max_world if max_world is not None else world,
+                    seq, elastic=elastic)
+
+    def test_places_at_max_world_when_room(self):
+        acts = plan(8, [], [Waiting("a", 0, 2, 4, 0)])
+        assert acts == [Place("a", 4)]
+
+    def test_bin_packs_leftover_capacity(self):
+        acts = plan(8, [], [Waiting("a", 0, 2, 6, 0),
+                            Waiting("b", 0, 2, 6, 1)])
+        assert acts == [Place("a", 6), Place("b", 2)]
+
+    def test_priority_orders_the_queue(self):
+        acts = plan(4, [], [Waiting("low", 0, 4, 4, 0),
+                            Waiting("high", 5, 4, 4, 1)])
+        assert acts == [Place("high", 4)]
+
+    def test_resume_keeps_original_seq_rank(self):
+        # the evictee (seq 0) outranks a later equal-priority arrival
+        acts = plan(4, [], [Waiting("late", 0, 4, 4, 7),
+                            Waiting("back", 0, 4, 4, 0, resume=True)])
+        assert acts == [Place("back", 4, resume=True)]
+
+    def test_shrink_before_evict(self):
+        # the drill scenario: elastic a gives one device, rigid b evicts
+        running = [self._slot("a", 4, min_world=3, max_world=4, seq=0),
+                   self._slot("b", 3, seq=1, elastic=False)]
+        acts = plan(8, running, [Waiting("c", 10, 4, 4, 2)])
+        assert acts == [Shrink("a", 3), Evict("b"), Place("c", 4)]
+
+    def test_shrink_alone_when_it_suffices(self):
+        running = [self._slot("a", 6, min_world=2, max_world=6, seq=0)]
+        acts = plan(8, running, [Waiting("c", 10, 4, 4, 1)])
+        assert acts == [Shrink("a", 4), Place("c", 4)]
+
+    def test_equal_priority_never_preempts(self):
+        running = [self._slot("a", 4, min_world=2, max_world=4, seq=0)]
+        acts = plan(4, running, [Waiting("b", 0, 2, 4, 1)])
+        assert acts == []
+
+    def test_eviction_order_latest_admitted_first(self):
+        running = [self._slot("a", 4, seq=0, elastic=False),
+                   self._slot("b", 4, seq=1, elastic=False)]
+        acts = plan(8, running, [Waiting("c", 10, 4, 4, 2)])
+        assert acts == [Evict("b"), Place("c", 4)]
+
+    def test_no_growth_while_anyone_waits(self):
+        running = [self._slot("a", 2, min_world=2, max_world=8, seq=0)]
+        acts = plan(8, running, [Waiting("big", 0, 7, 7, 1)])
+        assert acts == []  # capacity is spoken for, even if unplaced yet
+
+    def test_no_growth_on_an_evicting_tick(self):
+        running = [self._slot("a", 2, min_world=2, max_world=8,
+                              seq=0, priority=5),
+                   self._slot("b", 6, seq=1, elastic=False)]
+        acts = plan(8, running, [Waiting("c", 10, 6, 6, 2)])
+        assert acts == [Evict("b"), Place("c", 6)]  # no Grow("a") rider
+
+    def test_growth_toward_max_world_when_queue_empty(self):
+        running = [self._slot("a", 2, min_world=2, max_world=4, seq=1),
+                   self._slot("b", 2, min_world=2, max_world=4, seq=0)]
+        acts = plan(8, running, [])
+        # priority tie -> earliest admitted grows first, then the rest
+        assert acts == [Grow("b", 4), Grow("a", 4)]
+        # a lone grower takes everything up to its max_world
+        acts = plan(8, [self._slot("b", 2, min_world=2, max_world=8,
+                                   seq=0)], [])
+        assert acts == [Grow("b", 8)]
+
+    def test_rigid_slot_never_shrinks(self):
+        running = [self._slot("a", 4, min_world=2, max_world=4, seq=0,
+                              elastic=False)]
+        acts = plan(8, running, [Waiting("c", 10, 6, 6, 1)])
+        assert acts == [Evict("a"), Place("c", 6)]
+
+    def test_impossible_spec_does_not_wedge_the_queue(self):
+        acts = plan(4, [], [Waiting("huge", 9, 5, 5, 0),
+                            Waiting("ok", 0, 2, 2, 1)])
+        assert acts == [Place("ok", 2)]
+
+
+@pytest.mark.quick
+class TestDevicePool:
+    def test_contiguous_first_fit(self):
+        pool = DevicePool(8)
+        assert pool.allocate(4) == (0, 1, 2, 3)
+        assert pool.allocate(3) == (4, 5, 6)
+        pool.release((4, 5, 6))
+        assert pool.allocate(4) == (4, 5, 6, 7)
+
+    def test_fragmented_falls_back_to_lowest_ids(self):
+        pool = DevicePool(6)
+        a = pool.allocate(2)            # (0, 1)
+        b = pool.allocate(2)            # (2, 3)
+        pool.allocate(2)                # (4, 5)
+        pool.release(a)
+        pool.release(b[1:])             # free = {0, 1, 3}: no run of 3
+        assert pool.allocate(3) == (0, 1, 3)
+
+    def test_over_allocation_raises(self):
+        pool = DevicePool(2)
+        with pytest.raises(ValueError):
+            pool.allocate(3)
+        with pytest.raises(ValueError):
+            pool.allocate(0)
+
+    def test_double_release_and_range_checked(self):
+        pool = DevicePool(2)
+        ids = pool.allocate(2)
+        pool.release(ids)
+        with pytest.raises(ValueError):
+            pool.release((0,))
+        with pytest.raises(ValueError):
+            pool.release((9,))
+
+
+@pytest.mark.quick
+class TestFleetStateTornReads:
+    """Every shared-dir read must answer None (or skip the file) on
+    torn/partial/foreign content — never raise out of the decision loop
+    (style of tests/test_resilience.py::TestTornReads)."""
+
+    def test_torn_job_record_reads_none(self, tmp_path):
+        d = str(tmp_path)
+        fstate.write_job_record(d, {"job_id": "a", "status": "running"})
+        path = os.path.join(fstate.jobs_dir(d), "job.a.json")
+        with open(path, "w") as f:
+            f.write('{"job_id": "a", "sta')      # torn mid-record
+        assert fstate.read_job_record(d, "a") is None
+        assert fstate.list_job_records(d) == []
+
+    def test_garbage_and_wrong_shape_read_none(self, tmp_path):
+        d = str(tmp_path)
+        os.makedirs(fstate.jobs_dir(d))
+        path = os.path.join(fstate.jobs_dir(d), "job.a.json")
+        with open(path, "wb") as f:
+            f.write(b"\xff\xfe\x00garbage\x80")
+        assert fstate.read_job_record(d, "a") is None
+        with open(path, "w") as f:
+            f.write("[1, 2]")                    # valid JSON, not a record
+        assert fstate.read_job_record(d, "a") is None
+        with open(path, "w") as f:
+            f.write('{"status": "running"}')     # missing job_id
+        assert fstate.read_job_record(d, "a") is None
+
+    def test_torn_pool_record_reads_none(self, tmp_path):
+        d = str(tmp_path)
+        fstate.write_pool_record(d, {"pool_size": 8})
+        with open(fstate.pool_path(d), "w") as f:
+            f.write('{"pool_si')
+        assert fstate.read_pool_record(d) is None
+
+    def test_torn_submission_skipped_not_rejected(self, tmp_path):
+        # an in-flight write is picked up next tick, not bounced
+        d = str(tmp_path)
+        os.makedirs(fstate.queue_dir(d))
+        with open(os.path.join(fstate.queue_dir(d), "submit.a.json"),
+                  "w") as f:
+            f.write('{"spec": {"job_')
+        assert fstate.pending_submissions(d) == []
+
+    def test_malformed_spec_surfaces_with_error(self, tmp_path):
+        d = str(tmp_path)
+        os.makedirs(fstate.queue_dir(d))
+        with open(os.path.join(fstate.queue_dir(d), "submit.a.json"),
+                  "w") as f:
+            json.dump({"spec": {"job_id": "a", "command": []}, "ts": 1.0}, f)
+        [(spec, rec)] = fstate.pending_submissions(d)
+        assert spec is None and rec["job_id"] == "a"
+        assert "command" in rec["error"]
+
+    def test_queue_file_naming_a_different_job_is_rejected(self, tmp_path):
+        d = str(tmp_path)
+        fstate.submit_job(d, _spec("real"), ts=1.0)
+        os.rename(os.path.join(fstate.queue_dir(d), "submit.real.json"),
+                  os.path.join(fstate.queue_dir(d), "submit.fake.json"))
+        [(spec, rec)] = fstate.pending_submissions(d)
+        assert spec is None and rec["job_id"] == "fake"
+
+    def test_stray_tmp_files_are_invisible(self, tmp_path):
+        d = str(tmp_path)
+        fstate.write_job_record(d, {"job_id": "a", "status": "done"})
+        with open(os.path.join(fstate.jobs_dir(d),
+                               "job.a.json.999.tmp"), "w") as f:
+            f.write("{")
+        assert [r["job_id"] for r in fstate.list_job_records(d)] == ["a"]
+
+    def test_submission_order_replays_from_record_ts(self, tmp_path):
+        d = str(tmp_path)
+        fstate.submit_job(d, _spec("later"), ts=2.0)
+        fstate.submit_job(d, _spec("earlier"), ts=1.0)
+        ids = [s.job_id for s, _ in fstate.pending_submissions(d)]
+        assert ids == ["earlier", "later"]
+        fstate.clear_submission(d, "earlier")
+        fstate.clear_submission(d, "missing")    # idempotent
+        assert [s.job_id for s, _ in fstate.pending_submissions(d)] \
+            == ["later"]
+
+    def test_writer_replace_is_atomic_under_hammer(self, tmp_path):
+        """A hot writer thread + a hot reader: every read observes either
+        None or a COMPLETE record through the tmp+replace protocol."""
+        d = str(tmp_path)
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                fstate.write_job_record(
+                    d, {"job_id": "a", "status": "running", "seq": i,
+                        "devices": list(range(8))})
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            deadline, reads = time.time() + 0.5, 0
+            while time.time() < deadline:
+                rec = fstate.read_job_record(d, "a")
+                if rec is not None:
+                    assert set(rec) == {"job_id", "status", "seq",
+                                        "devices"}, rec
+                    reads += 1
+        finally:
+            stop.set()
+            t.join()
+        assert reads > 0, "reader never observed a complete record"
+
+
+class _Recorder:
+    """events= stand-in: collects (kind, fields)."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append((kind, fields))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+
+class _ScriptedController(JobController):
+    """One fake 'update' per poll; eviction checkpoints the applied count
+    and returns PREEMPT_EXIT; resume restores it.  ``script[job_id]`` can
+    override poll results to drive crash/unhealthy paths."""
+
+    resizable = True
+
+    def __init__(self, targets, script=None):
+        self.targets = targets
+        self.script = dict(script or {})
+        self.live = {}            # job_id -> {"applied": int, "world": int}
+        self.saved = {}           # job_id -> applied at eviction
+        self.calls = []
+
+    def start(self, spec, world, devices, *, resume):
+        applied = self.saved.pop(spec.job_id, 0) if resume else 0
+        self.live[spec.job_id] = {"applied": applied, "world": world}
+        self.calls.append(("start", spec.job_id, world, tuple(devices),
+                           resume))
+
+    def evict(self, job_id):
+        j = self.live.pop(job_id)
+        self.saved[job_id] = j["applied"]
+        self.calls.append(("evict", job_id))
+        return PREEMPT_EXIT
+
+    def shrink(self, job_id, world):
+        self.live[job_id]["world"] = world
+        self.calls.append(("shrink", job_id, world))
+
+    def grow(self, job_id, world, new_devices):
+        self.live[job_id]["world"] = world
+        self.calls.append(("grow", job_id, world, tuple(new_devices)))
+
+    def poll(self, job_id):
+        if self.script.get(job_id):
+            return self.script[job_id].pop(0)
+        j = self.live[job_id]
+        j["applied"] += 1
+        if j["applied"] >= self.targets.get(job_id, 1 << 30):
+            self.live.pop(job_id)
+            return {"exit_code": 0, "applied_updates": j["applied"]}
+        return {"exit_code": None, "applied_updates": j["applied"]}
+
+
+def _sched(tmp_path, ctrl, pool=8, **kw):
+    rec = _Recorder()
+    wall_state = [0.0]
+
+    def wall():
+        wall_state[0] += 1.0
+        return wall_state[0]
+
+    kw.setdefault("log", lambda s: None)
+    return FleetScheduler(str(tmp_path), pool, ctrl, events=rec, wall=wall,
+                          **kw), rec
+
+
+@pytest.mark.quick
+class TestFleetScheduler:
+    def test_three_job_preemption_scenario(self, tmp_path):
+        """The drill timeline without JAX: high-priority jobC shrinks
+        elastic jobA through the readmit barrier, evicts rigid jobB
+        (emergency save -> resume), frees bin-pack back, everyone
+        finishes at its target applied-update count."""
+        targets = {"jobA": 8, "jobB": 5, "jobC": 3}
+        ctrl = _ScriptedController(targets)
+        sched, rec = _sched(tmp_path, ctrl)
+        sched.submit(_spec("jobA", min_world=3, max_world=4,
+                           target_updates=8))
+        sched.submit(_spec("jobB", min_world=3, max_world=3,
+                           target_updates=5))
+        for t in range(32):
+            if t == 3:
+                sched.submit(_spec("jobC", priority=10, min_world=4,
+                                   max_world=4, target_updates=3))
+            sched.tick()
+            if sched.idle():
+                break
+        assert sched.idle()
+        for job_id, tgt in targets.items():
+            job = sched.jobs[job_id]
+            assert (job.status, job.applied) == ("done", tgt), job_id
+        c = sched.counters
+        assert (c["evictions"], c["shrinks"], c["readmits"]) == (1, 1, 1)
+        assert c["preemptions"] == 0 and c["failures"] == 0
+        assert c["finishes"] == 3 and c["restarts"] == 0
+        # the evictee resumed from its emergency save, not from scratch
+        assert ("start", "jobB", 3, (3, 4, 5), True) in ctrl.calls
+        assert ("shrink", "jobA", 3) in ctrl.calls
+        assert ("grow", "jobA", 4, (6,)) in ctrl.calls
+        for kind in ("fleet_submit", "fleet_admit", "fleet_place",
+                     "fleet_shrink", "fleet_evict", "fleet_readmit",
+                     "fleet_finish"):
+            assert kind in rec.kinds(), kind
+        # shared-dir exports: job + pool records readable mid-flight
+        assert fstate.read_job_record(str(tmp_path), "jobA")["status"] \
+            == "done"
+        pool = fstate.read_pool_record(str(tmp_path))
+        assert pool["pool_size"] == 8 and pool["devices_free"] == 8
+        prom = open(os.path.join(fstate.prom_dir(str(tmp_path)),
+                                 "jobA.fleet.prom")).read()
+        assert 'job="jobA"' in prom and "fleet_applied_updates" in prom
+        assert "fleet_devices_free" in open(os.path.join(
+            fstate.prom_dir(str(tmp_path)), "fleet.prom")).read()
+
+    def test_external_preemption_requeues_without_budget_burn(self, tmp_path):
+        ctrl = _ScriptedController(
+            {"j": 2}, script={"j": [{"exit_code": PREEMPT_EXIT}]})
+        sched, rec = _sched(tmp_path, ctrl, pool=2, max_restarts=0)
+        sched.submit(_spec("j", min_world=2, max_world=2, target_updates=2))
+        for _ in range(8):
+            sched.tick()
+            if sched.idle():
+                break
+        job = sched.jobs["j"]
+        assert job.status == "done" and job.restarts == 0
+        assert sched.counters["preemptions"] == 1
+        assert "fleet_preempt" in rec.kinds()
+        # requeued with resume: the second start restores
+        starts = [c for c in ctrl.calls if c[0] == "start"]
+        assert [s[4] for s in starts] == [False, True]
+
+    def test_crash_burns_budget_then_fails(self, tmp_path):
+        ctrl = _ScriptedController(
+            {}, script={"j": [{"exit_code": 3}, {"exit_code": 3}]})
+        sched, rec = _sched(tmp_path, ctrl, pool=1, max_restarts=1)
+        sched.submit(_spec("j", target_updates=5))
+        for _ in range(6):
+            sched.tick()
+        job = sched.jobs["j"]
+        assert job.status == "failed" and job.restarts == 1
+        assert job.exit_code == 3
+        assert sched.counters["restarts"] == 1
+        assert sched.counters["failures"] == 1
+        assert rec.kinds().count("fleet_restart") == 1
+        assert "fleet_fail" in rec.kinds()
+        assert sched.pool.free_count == 1     # devices came back
+
+    def test_unhealthy_verdict_evicts_and_restarts(self, tmp_path):
+        ctrl = _ScriptedController(
+            {"j": 3}, script={"j": [{"exit_code": None, "healthy": False}]})
+        sched, rec = _sched(tmp_path, ctrl, pool=1, max_restarts=1)
+        sched.submit(_spec("j", target_updates=3))
+        for _ in range(10):
+            sched.tick()
+            if sched.idle():
+                break
+        assert ("evict", "j") in ctrl.calls   # killed, not abandoned
+        job = sched.jobs["j"]
+        assert job.status == "done" and job.restarts == 1
+        assert "fleet_restart" in rec.kinds()
+
+    def test_rejections(self, tmp_path):
+        ctrl = _ScriptedController({"ok": 1})
+        sched, rec = _sched(tmp_path, ctrl, pool=4)
+        sched.submit(_spec("ok", target_updates=1))
+        sched.submit(_spec("huge", min_world=5, max_world=5))
+        sched.tick()
+        sched.submit(_spec("ok", target_updates=1))   # duplicate job_id
+        with open(os.path.join(fstate.queue_dir(str(tmp_path)),
+                               "submit.bad.json"), "w") as f:
+            json.dump({"spec": {"job_id": "bad", "command": []}}, f)
+        sched.tick()
+        assert sched.counters["rejects"] == 3
+        rejected = {f["job"] for k, f in rec.events if k == "fleet_reject"}
+        assert rejected == {"huge", "ok", "bad"}
+        assert list(sched.jobs) == ["ok"]             # admitted exactly once
+        assert fstate.pending_submissions(str(tmp_path)) == []
+
+    def test_run_until_idle_ticks_and_sleeps(self, tmp_path):
+        ctrl = _ScriptedController({"j": 2})
+        sched, _ = _sched(tmp_path, ctrl, pool=1)
+        sched.submit(_spec("j", target_updates=2))
+        sleeps = []
+        ticks = sched.run(interval_s=0.5, sleep=sleeps.append,
+                          max_ticks=50, until_idle=True)
+        assert sched.idle() and ticks == 3
+        assert sleeps == [0.5, 0.5]           # no sleep after the idle tick
+
+
+@pytest.mark.quick
+class TestSpawnSupervised:
+    def _capture(self):
+        captured = {}
+
+        def popen(cmd, env):
+            captured["cmd"], captured["env"] = cmd, env
+            return "child"
+
+        return captured, popen
+
+    def test_env_composition_preserves_operator_vars(self):
+        captured, popen = self._capture()
+        child = spawn_supervised(
+            ("python", "-m", "x"), restart_count=4,
+            env={"OPERATOR_VAR": "kept", "PATH": "/bin"},
+            popen=popen, log=lambda s: None)
+        assert child == "child"
+        assert captured["cmd"] == ["python", "-m", "x"]
+        env = captured["env"]
+        assert env["OPERATOR_VAR"] == "kept" and env["PATH"] == "/bin"
+        assert env["TCDP_RESTART_COUNT"] == "4"
+        assert "TCDP_ELASTIC_DIR" not in env
+
+    def test_extra_env_wins_and_is_str_coerced(self):
+        captured, popen = self._capture()
+        spawn_supervised(
+            ("run",), restart_count=0, env={"TCDP_JOB_ID": "old"},
+            extra_env={"TCDP_JOB_ID": "new", "TCDP_FLEET_WORLD": 4},
+            popen=popen, log=lambda s: None)
+        env = captured["env"]
+        assert env["TCDP_JOB_ID"] == "new"
+        assert env["TCDP_FLEET_WORLD"] == "4"
+
+    def test_restart_count_is_supervisor_owned(self):
+        # unlike operator vars, the incarnation is always overwritten
+        captured, popen = self._capture()
+        spawn_supervised(("run",), restart_count=2,
+                         env={"TCDP_RESTART_COUNT": "99"},
+                         popen=popen, log=lambda s: None)
+        assert captured["env"]["TCDP_RESTART_COUNT"] == "2"
+
+    def test_elastic_dir_without_epoch_leaves_rejoin_keys_alone(self,
+                                                                tmp_path):
+        from tpu_compressed_dp.train.rendezvous import DIR_ENV, EPOCH_ENV
+
+        captured, popen = self._capture()
+        spawn_supervised(("run",), restart_count=0,
+                         elastic_dir=str(tmp_path),
+                         env={EPOCH_ENV: "operator-set"},
+                         popen=popen, log=lambda s: None)
+        env = captured["env"]
+        assert env[DIR_ENV] == str(tmp_path)
+        assert env[EPOCH_ENV] == "operator-set"   # no committed epoch: kept
+
+    def test_committed_epoch_exports_rejoin_hint(self, tmp_path):
+        from tpu_compressed_dp.train.rendezvous import (ADDR_ENV, DIR_ENV,
+                                                        EPOCH_ENV,
+                                                        write_epoch)
+
+        write_epoch(str(tmp_path), {"epoch": 3, "ranks": [0, 1],
+                                    "address": "host:1234"})
+        captured, popen = self._capture()
+        logs = []
+        spawn_supervised(("run",), restart_count=1,
+                         elastic_dir=str(tmp_path), env={},
+                         popen=popen, log=logs.append)
+        env = captured["env"]
+        assert env[DIR_ENV] == str(tmp_path)
+        assert env[EPOCH_ENV] == "3" and env[ADDR_ENV] == "host:1234"
+        assert any("world epoch 3" in m for m in logs)
+
+
+class TestFleetCLI:
+    def _submit(self, tmp_path, spec_dict, name="spec.json"):
+        import tools.fleet as fleet_cli
+
+        p = tmp_path / name
+        p.write_text(json.dumps(spec_dict))
+        return fleet_cli.main(["submit", "--fleet_dir",
+                               str(tmp_path / "fleet"), "--spec", str(p)])
+
+    def test_submit_queues_a_valid_spec(self, tmp_path, capsys):
+        rc = self._submit(tmp_path, {"job_id": "a", "command": ["true"],
+                                     "min_world": 1, "max_world": 2})
+        assert rc == 0
+        assert "queued a" in capsys.readouterr().out
+        [(spec, _)] = fstate.pending_submissions(str(tmp_path / "fleet"))
+        assert spec.job_id == "a" and spec.elastic
+
+    def test_submit_bounces_a_malformed_spec(self, tmp_path, capsys):
+        rc = self._submit(tmp_path, {"job_id": "a", "command": []})
+        assert rc == 2
+        assert "invalid spec" in capsys.readouterr().out
+        assert not os.path.isdir(fstate.queue_dir(str(tmp_path / "fleet")))
+
+    def test_status_without_a_pool_record(self, tmp_path, capsys):
+        import tools.fleet as fleet_cli
+
+        assert fleet_cli.main(["status", "--fleet_dir", str(tmp_path)]) == 2
+        assert "no pool record" in capsys.readouterr().out
+
+    def test_run_executes_real_subprocess_jobs(self, tmp_path, capsys):
+        """End-to-end over real children: two trivial jobs share a
+        2-device pool, finish, and land in the shared-dir records."""
+        import tools.fleet as fleet_cli
+
+        fleet_dir = str(tmp_path / "fleet")
+        for job_id in ("a", "b"):
+            assert self._submit(
+                tmp_path,
+                {"job_id": job_id,
+                 "command": [sys.executable, "-c", "pass"]},
+                name=f"{job_id}.json") == 0
+        rc = fleet_cli.main(["run", "--fleet_dir", fleet_dir,
+                             "--devices", "2", "--interval", "0.05",
+                             "--max_ticks", "200", "--until_idle"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "2 finished" in out
+        recs = {r["job_id"]: r for r in fstate.list_job_records(fleet_dir)}
+        assert {j: r["status"] for j, r in recs.items()} \
+            == {"a": "done", "b": "done"}
+        pool = fstate.read_pool_record(fleet_dir)
+        assert pool["devices_free"] == 2
+        # fleet_* events landed in the JSONL stream
+        from tpu_compressed_dp.obs.export import read_events
+
+        kinds = {e["kind"] for e in read_events(fstate.events_path(fleet_dir))}
+        assert {"fleet_admit", "fleet_place", "fleet_finish"} <= kinds
+
+    def test_run_reports_failed_jobs_nonzero(self, tmp_path):
+        import tools.fleet as fleet_cli
+
+        fleet_dir = str(tmp_path / "fleet")
+        assert self._submit(
+            tmp_path,
+            {"job_id": "crash",
+             "command": [sys.executable, "-c", "raise SystemExit(3)"]}) == 0
+        rc = fleet_cli.main(["run", "--fleet_dir", fleet_dir,
+                             "--devices", "1", "--interval", "0.05",
+                             "--max_ticks", "200", "--until_idle",
+                             "--max_restarts", "0"])
+        assert rc == 1
+        [rec] = fstate.list_job_records(fleet_dir)
+        assert rec["status"] == "failed" and rec["exit_code"] == 3

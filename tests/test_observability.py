@@ -487,6 +487,152 @@ class TestWatchdogRelaunch:
         assert killed == spawned  # the (only) child was cleaned up
 
 
+class TestPreemptStorm:
+    """The preempt-storm guard: free PREEMPT_EXIT respawns are rate-capped
+    — more than ``max_preempts`` inside the sliding window falls through
+    to the unhealthy path (budget, backoff, give-up) instead of respawning
+    forever on the supervisor's dime."""
+
+    def _drive(self, child_rcs, *, max_preempts, preempt_window_s=600.0,
+               max_relaunches=0, verdicts=()):
+        import tools.watchdog as wd
+
+        spawned, killed, sleeps = [], [], []
+
+        def spawn():
+            rc = (child_rcs[len(spawned)] if len(spawned) < len(child_rcs)
+                  else None)
+            c = TestWatchdogRelaunch._Child(rc)
+            spawned.append(c)
+            return c
+
+        it = iter(verdicts)
+        rc = wd.supervise(
+            spawn, lambda: next(it), interval_s=1.0, grace_s=0.0,
+            max_relaunches=max_relaunches, backoff_s=5.0,
+            backoff_cap_s=40.0, sleep=sleeps.append,
+            kill=lambda c, **k: killed.append(c), log=lambda m: None,
+            max_preempts=max_preempts, preempt_window_s=preempt_window_s)
+        return rc, spawned, killed, sleeps
+
+    def test_storm_gives_up_with_childs_exit_code(self):
+        from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT
+
+        rc, spawned, killed, sleeps = self._drive(
+            [PREEMPT_EXIT] * 3, max_preempts=2)
+        # two free respawns, the third preempt in the window is the storm:
+        # zero budget left => give up, propagating the child's exit 75
+        assert rc == PREEMPT_EXIT
+        assert len(spawned) == 3
+        assert len(killed) == 1
+        assert sleeps == [1.0, 1.0, 1.0]  # never a backoff, never a check
+
+    def test_storm_spends_the_budget_before_giving_up(self):
+        from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT
+
+        rc, spawned, killed, sleeps = self._drive(
+            [PREEMPT_EXIT, PREEMPT_EXIT, 0], max_preempts=1,
+            max_relaunches=1)
+        # preempt #2 is the storm, but one budgeted relaunch remains: kill,
+        # back off, respawn — and that child exits cleanly
+        assert rc == 0
+        assert len(spawned) == 3 and len(killed) == 1
+        assert sleeps == [1.0, 1.0, 5.0, 1.0]
+
+    def test_preempts_outside_the_window_never_storm(self):
+        from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT
+
+        # window shorter than the tick spacing: each preempt evicts the
+        # previous from the deque — five in a row stay "free" even at cap 1
+        rc, spawned, killed, sleeps = self._drive(
+            [PREEMPT_EXIT] * 5 + [0], max_preempts=1, preempt_window_s=0.5)
+        assert rc == 0
+        assert len(spawned) == 6 and killed == []
+        assert sleeps == [1.0] * 6
+
+    def test_cap_none_disables_the_guard(self):
+        from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT
+
+        rc, spawned, killed, _ = self._drive(
+            [PREEMPT_EXIT] * 9 + [0], max_preempts=None)
+        assert rc == 0 and len(spawned) == 10 and killed == []
+
+class TestJobNamespacing:
+    """Per-job telemetry namespacing (--job_id / $TCDP_JOB_ID): two jobs
+    sharing one textfile-collector or heartbeat dir must never clobber
+    each other's files, and the exposition carries a job label."""
+
+    def test_job_scoped_path(self):
+        assert obs_export.job_scoped_path("/x/hb.json", "jobA") \
+            == "/x/jobA.hb.json"
+        assert obs_export.job_scoped_path("hb.json", "jobA") == "jobA.hb.json"
+        assert obs_export.job_scoped_path("/x/hb.json", None) == "/x/hb.json"
+        assert obs_export.job_scoped_path(None, "jobA") is None
+
+    def test_prom_labels_and_job_scoped_args(self):
+        import argparse
+
+        from tpu_compressed_dp.harness import loop
+
+        args = argparse.Namespace(job_id="lm-a")
+        assert loop.job_scoped(args, "/m/metrics.prom") \
+            == "/m/lm-a.metrics.prom"
+        assert loop.prom_labels(args, harness="lm") \
+            == {"harness": "lm", "job": "lm-a"}
+        solo = argparse.Namespace(job_id=None)
+        assert loop.job_scoped(solo, "/m/metrics.prom") == "/m/metrics.prom"
+        assert loop.prom_labels(solo, harness="lm") == {"harness": "lm"}
+
+    def test_job_id_defaults_from_fleet_env(self, monkeypatch):
+        import argparse
+
+        from tpu_compressed_dp.harness import loop
+
+        monkeypatch.setenv("TCDP_JOB_ID", "from-env")
+        p = argparse.ArgumentParser()
+        loop.add_telemetry_args(p)
+        assert p.parse_args([]).job_id == "from-env"
+        assert p.parse_args(["--job_id", "cli-wins"]).job_id == "cli-wins"
+
+    def test_two_jobs_share_a_prom_dir_without_clobbering(self, tmp_path):
+        base = str(tmp_path / "metrics.prom")
+        for job in ("jobA", "jobB"):
+            obs_export.write_prometheus(
+                {"fleet/world": 4.0}, obs_export.job_scoped_path(base, job),
+                labels={"job": job})
+        a = (tmp_path / "jobA.metrics.prom").read_text()
+        b = (tmp_path / "jobB.metrics.prom").read_text()
+        assert 'job="jobA"' in a and 'job="jobB"' in b
+        assert not (tmp_path / "metrics.prom").exists()
+
+    def test_heartbeat_is_job_scoped_and_labelled(self, tmp_path):
+        import argparse
+
+        from tpu_compressed_dp.harness import loop
+        from tpu_compressed_dp.utils.resilience import read_heartbeat
+
+        args = argparse.Namespace(job_id="lm-a",
+                                  heartbeat=str(tmp_path / "hb.json"),
+                                  heartbeat_interval=30.0)
+        hb = loop.make_heartbeat(args)
+        try:
+            hb.update(step=3)
+        finally:
+            hb.stop()
+        rec = read_heartbeat(str(tmp_path / "lm-a.hb.json"))
+        assert rec is not None and rec["job"] == "lm-a"
+        assert not (tmp_path / "hb.json").exists()
+
+    def test_fleet_metrics_declared_in_registry(self):
+        from tpu_compressed_dp.obs import registry
+
+        for name in ("fleet/world", "fleet/applied_updates",
+                     "fleet/jobs_running", "fleet/devices_free",
+                     "fleet/evictions", "fleet/shrinks", "fleet/readmits"):
+            assert registry.is_declared(name), name
+            assert registry.spec(name).emitter == "host", name
+
+
 @pytest.mark.quick
 class TestTraceReport:
     def _events(self, tmp_path):

@@ -70,6 +70,18 @@ Control drill (the ISSUE 11 acceptance row — control/):
     the same rung schedule and the same ``control_decision`` events, field
     for field, as the uninterrupted run.
 
+Fleet drill (the ISSUE 12 acceptance row — fleet/ + tools/fleet.py):
+
+  * ``fleet`` — three jobs, one 8-device pool: a high-priority arrival
+    EVICTS one job (emergency checkpoint + exit 75, resumed when capacity
+    clears) and SHRINKS an elastic one through the readmit barrier; freed
+    slices bin-pack back (the evictee re-places, the shrunk job grows
+    back to ``max_world``), every job finishes bitwise identical to a
+    solo run of its applied-update/world trajectory, and every transition
+    lands as ``fleet_*`` JSONL events + per-job Prometheus rollups.
+  * ``fleet_matrix`` — the EF-policy cross (fold/drop) plus the rigid
+    cell (no elastic slot => the planner preempts by eviction only).
+
 Usage::
 
     python tools/chaos_drill.py --quick     # tier-1 smoke subset (~4 drills)
@@ -845,14 +857,207 @@ def drill_elastic_cascade(mesh) -> Dict:
     return {"world": el.world, "cascades": el.cascade_count}
 
 
+def drill_fleet(mesh, *, policy="fold", elastic=True) -> Dict:
+    """Three jobs, one 8-device pool (the ISSUE 12 acceptance drill): a
+    high-priority arrival EVICTS one job (emergency checkpoint, exit 75)
+    and — when jobA is elastic — SHRINKS another through the readmit
+    barrier; freed slices bin-pack back, and every job finishes bitwise
+    identical to a solo run of the same applied-update/world trajectory.
+    ``elastic=False`` runs the rigid cell: no shrink candidate, so the
+    planner evicts instead (evict-only preemption path)."""
+    from tpu_compressed_dp.fleet import FleetScheduler, JobController, JobSpec
+    from tpu_compressed_dp.fleet import state as fstate
+    from tpu_compressed_dp.obs.export import EventStream, read_events
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.parallel.mesh import make_data_mesh
+    from tpu_compressed_dp.train.elastic import (ElasticConfig,
+                                                 ElasticRuntime, PeerFailed)
+    from tpu_compressed_dp.utils.checkpoint import Checkpointer
+    from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT
+
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True,
+                             mode="simulate", granularity="entiremodel")
+    pool = int(mesh.shape["data"])
+    devs_all = list(mesh.devices.flat)   # pool id i -> physical device
+    # targets chosen so jobA outlives jobC: the freed slices have a live
+    # elastic job to grow back into (the readmit half of the shrink)
+    targets = {"jobA": 8, "jobB": 5, "jobC": 3}
+    batches = {j: [_batch(seed=base + i, n=12) for i in range(targets[j])]
+               for j, base in (("jobA", 100), ("jobB", 200), ("jobC", 300))}
+    specs = [
+        JobSpec("jobA", ("sim",), priority=0,
+                min_world=3 if elastic else 4, max_world=4,
+                target_updates=targets["jobA"]),
+        JobSpec("jobB", ("sim",), priority=0, min_world=3, max_world=3,
+                target_updates=targets["jobB"]),
+        JobSpec("jobC", ("sim",), priority=10, min_world=4, max_world=4,
+                target_updates=targets["jobC"]),
+    ]
+
+    class _SimController(JobController):
+        """In-process jobs: one training update per poll, shrink/grow
+        through the job's own ElasticRuntime, eviction = a real emergency
+        checkpoint + PREEMPT_EXIT, resume = restore on the newly granted
+        slice.  Pool ids are capacity bookkeeping; each placement maps
+        them onto the drill mesh's physical devices."""
+
+        resizable = True
+
+        def __init__(self, root):
+            self.root = root
+            self.jobs: Dict[str, Dict] = {}
+            self.finals: Dict[str, Dict] = {}
+            self.traj = []               # (job_id, kind, applied, world)
+
+        def _ckpt_dir(self, job_id):
+            return os.path.join(self.root, "ckpt", job_id)
+
+        def start(self, spec, world, devices, *, resume):
+            m = make_data_mesh(devices=tuple(devs_all[d] for d in devices))
+            state, _, step_for = _tiny_setup(m, comp, None, None,
+                                             with_factory=True)
+            el = ElasticRuntime(ElasticConfig(ef_policy=policy), m,
+                                log=lambda s: None)
+            applied = 0
+            if resume:
+                ck = Checkpointer(self._ckpt_dir(spec.job_id))
+                state, meta = ck.restore(state)
+                ck.close()
+                state = state.with_mesh_sharding(m)
+                assert meta.get("emergency") is True, meta
+                applied = int(meta["applied"])
+            self.jobs[spec.job_id] = {
+                "spec": spec, "state": state, "el": el,
+                "step": step_for(m), "step_for": step_for,
+                "applied": applied}
+
+        def evict(self, job_id):
+            j = self.jobs.pop(job_id)
+            ck = Checkpointer(self._ckpt_dir(job_id))
+            ck.save(j["state"], {"applied": j["applied"], "emergency": True})
+            ck.close()
+            return PREEMPT_EXIT
+
+        def shrink(self, job_id, world):
+            j = self.jobs[job_id]
+            el = j["el"]
+            self.traj.append((job_id, "shrink", j["applied"], world))
+            while el.world > world:
+                j["state"] = el.handle_failure(
+                    j["state"], PeerFailed((el.world - 1,), step=j["applied"],
+                                           reason="fleet preemption"))
+            j["step"] = j["step_for"](el.mesh)
+
+        def grow(self, job_id, world, new_devices):
+            j = self.jobs[job_id]
+            self.traj.append((job_id, "readmit", j["applied"], world))
+            j["state"] = j["el"].readmit(j["state"])
+            assert j["el"].world == world, (j["el"].world, world)
+            j["step"] = j["step_for"](j["el"].mesh)
+
+        def poll(self, job_id):
+            j = self.jobs[job_id]
+            j["state"], _ = j["step"](j["state"],
+                                      batches[job_id][j["applied"]])
+            j["applied"] += 1
+            if j["applied"] >= targets[job_id]:
+                self.finals[job_id] = _snap(j["state"])
+                self.jobs.pop(job_id)
+                return {"exit_code": 0, "applied_updates": j["applied"]}
+            return {"exit_code": None, "applied_updates": j["applied"]}
+
+    with tempfile.TemporaryDirectory() as td:
+        ctrl = _SimController(td)
+        events = EventStream(fstate.events_path(td))
+        now = [0.0]
+
+        def wall():
+            now[0] += 1.0
+            return now[0]
+
+        sched = FleetScheduler(td, pool, ctrl, events=events, wall=wall,
+                               log=lambda s: None)
+        sched.submit(specs[0])
+        sched.submit(specs[1])
+        for t in range(64):
+            if t == 3:
+                sched.submit(specs[2])   # the high-priority arrival
+            sched.tick()
+            if sched.idle():
+                break
+        events.close()
+
+        assert sched.idle(), "fleet never drained"
+        for job_id, tgt in targets.items():
+            job = sched.jobs[job_id]
+            assert job.status == "done" and job.applied == tgt, \
+                (job_id, job.status, job.applied)
+        c = sched.counters
+        want = ({"evictions": 1, "shrinks": 1, "readmits": 1} if elastic
+                else {"evictions": 1, "shrinks": 0, "readmits": 0})
+        for k, v in want.items():
+            assert c[k] == v, (k, c[k], v)
+        assert c["preemptions"] == 0 and c["failures"] == 0, c
+
+        # every transition is on the wire: fleet_* JSONL events + per-job
+        # Prometheus rollups with the job label
+        kinds = {e["kind"] for e in read_events(fstate.events_path(td))}
+        need = {"fleet_submit", "fleet_admit", "fleet_place", "fleet_evict",
+                "fleet_finish"}
+        if elastic:
+            need |= {"fleet_shrink", "fleet_readmit"}
+        assert need <= kinds, need - kinds
+        for job_id in targets:
+            prom = open(
+                f"{fstate.prom_dir(td)}/{job_id}.fleet.prom").read()
+            assert f'job="{job_id}"' in prom and "fleet_world" in prom
+        assert "fleet_devices_free" in open(
+            f"{fstate.prom_dir(td)}/fleet.prom").read()
+
+        # bitwise acceptance: each job vs a solo run replaying the same
+        # applied-update count and (for jobA) the same world trajectory
+        traj = {}
+        for job_id, kind, applied, world in ctrl.traj:
+            traj.setdefault(job_id, []).append((applied, kind, world))
+        solo_world = {"jobA": 4, "jobB": 3, "jobC": 4}
+        for job_id, tgt in targets.items():
+            m = make_data_mesh(
+                devices=tuple(devs_all[:solo_world[job_id]]))
+            state, _, step_for = _tiny_setup(m, comp, None, None,
+                                             with_factory=True)
+            el = ElasticRuntime(ElasticConfig(ef_policy=policy), m,
+                                log=lambda s: None)
+            step = step_for(m)
+            for i in range(tgt):
+                for at, kind, world in traj.get(job_id, ()):
+                    if at != i:
+                        continue
+                    if kind == "shrink":
+                        while el.world > world:
+                            state = el.handle_failure(
+                                state, PeerFailed((el.world - 1,), step=i,
+                                                  reason="fleet preemption"))
+                    else:
+                        state = el.readmit(state)
+                    step = step_for(el.mesh)
+                state, _ = step(state, batches[job_id][i])
+            _assert_bitwise(_snap(state), ctrl.finals[job_id],
+                            f"fleet {job_id} vs solo")
+
+    return {"world": pool, "evictions": c["evictions"],
+            "shrinks": c["shrinks"], "readmits": c["readmits"],
+            "bitwise": True}
+
+
 # -------------------------------------------------------------------- main
 
 QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery",
          "elastic_gossip", "elastic_remesh", "ckpt_preempt", "ckpt_corrupt",
-         "control_resume"]
+         "control_resume", "fleet"]
 FULL = QUICK + ["comp_hold", "ef_identity", "poison_control",
                 "skip_matrix", "ef_identity_sharded",
-                "elastic_readmit", "elastic_cascade", "elastic_matrix"]
+                "elastic_readmit", "elastic_cascade", "elastic_matrix",
+                "fleet_matrix"]
 
 
 def expand_rows(names) -> list:
@@ -872,6 +1077,8 @@ def expand_rows(names) -> list:
                      for worker in (0, 7)
                      for kill_step in (0, 3)]
             rows.append("elastic[sharded-wire]")
+        elif name == "fleet_matrix":
+            rows += ["fleet[fold]", "fleet[drop]", "fleet[rigid]"]
         else:
             rows.append(name)
     return rows
@@ -908,6 +1115,15 @@ def run_drills(names, mesh=None) -> Dict[str, Dict]:
                 policy="fold")
             print(f"PASS {key}")
             continue
+        if name == "fleet_matrix":
+            # EF-policy cells through the shrink/readmit barrier, plus the
+            # rigid cell (no shrink candidate => evict-only preemption)
+            for key, kwargs in (("fleet[fold]", {"policy": "fold"}),
+                                ("fleet[drop]", {"policy": "drop"}),
+                                ("fleet[rigid]", {"elastic": False})):
+                results[key] = drill_fleet(mesh, **kwargs)
+                print(f"PASS {key}")
+            continue
         if name == "ef_identity_sharded":
             results[name] = drill_ef_identity(mesh, transport="sharded",
                                               mode="wire")
@@ -923,7 +1139,7 @@ def main(argv=None) -> int:
                    help="tier-1 smoke subset (skip_consistency, loss_scale, "
                         "max_skips, crash_recovery, elastic_gossip, "
                         "elastic_remesh, ckpt_preempt, ckpt_corrupt, "
-                        "control_resume)")
+                        "control_resume, fleet)")
     p.add_argument("--drill", action="append", default=None,
                    help="run only the named drill(s)")
     p.add_argument("--list", action="store_true",

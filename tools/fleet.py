@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Fleet CLI — many jobs, one device pool (tpu_compressed_dp/fleet/).
+
+Three subcommands over one shared ``--fleet_dir``:
+
+``submit``
+    Validate a JSON job spec (a file, or ``-`` for stdin) and drop it
+    into the admission queue.  Spec schema (see
+    :class:`tpu_compressed_dp.fleet.spec.JobSpec`)::
+
+        {"job_id": "lm-a", "priority": 0,
+         "min_world": 2, "max_world": 4,
+         "command": ["python", "-m", "tpu_compressed_dp.harness.lm",
+                     "--synthetic", "--heartbeat", "fleet/hb/hb.json",
+                     "--prom", "fleet/prom/metrics.prom"],
+         "target_updates": null, "checkpoint_dir": "ckpts/lm-a"}
+
+``run``
+    The scheduler process: admits the queue over a ``--devices``-sized
+    pool, places/preempts/resumes jobs as subprocesses, writes per-job +
+    pool Prometheus rollups and ``fleet_*`` JSONL events under the fleet
+    dir.  Each child is launched through
+    ``utils.resilience.spawn_supervised`` with ``TCDP_JOB_ID`` (so the
+    harness job-scopes its heartbeat/prom/event files and labels its
+    exposition), ``TCDP_FLEET_WORLD`` and ``TCDP_FLEET_DEVICES`` (the
+    assigned device-id slice), plus the usual ``TCDP_RESTART_COUNT``
+    incarnation.  Eviction is the PR-8 preempt path: SIGTERM -> the
+    harness drains + cuts an emergency save -> exit 75 -> requeued for
+    bitwise resume when capacity returns.  The v1 subprocess controller
+    is NOT resizable — elastic in-place shrink/grow needs the in-process
+    controller (see the fleet drill in tools/chaos_drill.py); here an
+    elastic spec still helps (the job places anywhere in
+    [min_world, max_world]) but preemption always evicts whole jobs.
+
+``status``
+    Print the pool record and the per-job table from the shared dir
+    (works from any process while ``run`` ticks).
+
+Heartbeat verdicts: point each job's ``--heartbeat`` at
+``<fleet_dir>/hb/hb.json`` — the harness's ``--job_id`` scoping turns
+that into ``hb/<job_id>.hb.json``, which the controller polls with
+``check_heartbeat`` after ``--grace`` seconds; an unhealthy job is
+killed and requeued until its restart budget is spent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_compressed_dp.fleet import (FleetScheduler, JobController, JobSpec,
+                                     SpecError)
+from tpu_compressed_dp.fleet import state as fstate
+from tpu_compressed_dp.obs.export import EventStream, job_scoped_path
+from tpu_compressed_dp.utils.resilience import (check_heartbeat,
+                                                read_heartbeat,
+                                                spawn_supervised)
+
+
+class SubprocessController(JobController):
+    """Jobs as supervised child processes (``resizable = False``: v1
+    preemption evicts whole jobs; in-place shrink/grow is the in-process
+    controller's territory)."""
+
+    resizable = False
+
+    def __init__(self, fleet_dir: str, *, term_timeout_s: float = 30.0,
+                 grace_s: float = 60.0, hb_max_age_s: float = 120.0,
+                 log=print):
+        self.fleet_dir = fleet_dir
+        self.term_timeout_s = float(term_timeout_s)
+        self.grace_s = float(grace_s)
+        self.hb_max_age_s = float(hb_max_age_s)
+        self.log = log
+        self.children: Dict[str, "object"] = {}
+        self.started_at: Dict[str, float] = {}
+        self.incarnations: Dict[str, int] = {}
+
+    def _hb_path(self, job_id: str) -> Optional[str]:
+        return job_scoped_path(
+            os.path.join(self.fleet_dir, "hb", "hb.json"), job_id)
+
+    def start(self, spec: JobSpec, world: int, devices: Tuple[int, ...],
+              *, resume: bool) -> None:
+        os.makedirs(os.path.join(self.fleet_dir, "hb"), exist_ok=True)
+        inc = self.incarnations.get(spec.job_id, 0)
+        self.children[spec.job_id] = spawn_supervised(
+            spec.command, restart_count=inc,
+            extra_env={"TCDP_JOB_ID": spec.job_id,
+                       "TCDP_FLEET_WORLD": str(world),
+                       "TCDP_FLEET_DEVICES": ",".join(str(d) for d in devices)},
+            log=self.log)
+        self.incarnations[spec.job_id] = inc + 1
+        self.started_at[spec.job_id] = time.time()
+        self.log(f"fleet: started {spec.job_id} world={world} "
+                 f"devices={list(devices)} resume={resume}")
+
+    def evict(self, job_id: str) -> int:
+        child = self.children.pop(job_id, None)
+        if child is None:
+            return -1
+        if child.poll() is None:
+            child.terminate()  # the harness's preempt path: emergency save
+            try:
+                child.wait(timeout=self.term_timeout_s)
+            except Exception:
+                child.kill()
+                child.wait()
+        return int(child.returncode)
+
+    def poll(self, job_id: str) -> Dict[str, object]:
+        child = self.children.get(job_id)
+        if child is None:
+            return {"exit_code": -1}
+        out: Dict[str, object] = {"exit_code": child.poll()}
+        if out["exit_code"] is not None:
+            self.children.pop(job_id, None)
+        hb_path = self._hb_path(job_id)
+        hb = read_heartbeat(hb_path) if hb_path else None
+        if hb is not None:
+            watermark = hb.get("last_good_step", hb.get("step"))
+            if isinstance(watermark, (int, float)):
+                out["applied_updates"] = int(watermark)
+        if (out["exit_code"] is None
+                and time.time() - self.started_at.get(job_id, 0.0)
+                > self.grace_s):
+            problems = check_heartbeat(hb_path, max_age_s=self.hb_max_age_s,
+                                       hb=hb)
+            out["healthy"] = not problems
+            if problems:
+                self.log(f"fleet: {job_id} heartbeat: {problems[0]}")
+        return out
+
+    def shutdown(self) -> None:
+        """Terminate every surviving child (the run loop's finally — an
+        interrupted scheduler must not orphan its jobs)."""
+        for job_id in list(self.children):
+            rc = self.evict(job_id)
+            self.log(f"fleet: shutdown: {job_id} exited {rc}")
+
+
+def run_submit(args) -> int:
+    text = (sys.stdin.read() if args.spec == "-"
+            else open(args.spec).read())
+    try:
+        spec = JobSpec.parse(text)
+    except SpecError as e:
+        print(f"fleet: invalid spec: {e}")
+        return 2
+    path = fstate.submit_job(args.fleet_dir, spec, ts=time.time())
+    print(f"fleet: queued {spec.job_id} (priority {spec.priority}, world "
+          f"[{spec.min_world}, {spec.max_world}]) -> {path}")
+    return 0
+
+
+def run_run(args) -> int:
+    controller = SubprocessController(
+        args.fleet_dir, term_timeout_s=args.term_timeout,
+        grace_s=args.grace, hb_max_age_s=args.max_age)
+    events = EventStream(fstate.events_path(args.fleet_dir),
+                         meta={"pool_size": args.devices})
+    sched = FleetScheduler(args.fleet_dir, args.devices, controller,
+                           events=events, max_restarts=args.max_restarts)
+    try:
+        ticks = sched.run(interval_s=args.interval,
+                          max_ticks=args.max_ticks,
+                          until_idle=args.until_idle)
+    finally:
+        controller.shutdown()
+        events.close()
+    c = sched.counters
+    print(f"fleet: {ticks} ticks — {c['finishes']} finished, "
+          f"{c['failures']} failed, {c['evictions']} evictions, "
+          f"{c['shrinks']} shrinks, {c['readmits']} readmits")
+    failed = [j for j in sched.jobs.values() if j.status == "failed"]
+    return 1 if failed else 0
+
+
+def run_status(args) -> int:
+    pool = fstate.read_pool_record(args.fleet_dir)
+    if pool is None:
+        print(f"fleet: no pool record under {args.fleet_dir} (scheduler "
+              "not started?)")
+        return 2
+    c = pool.get("counters", {})
+    print(f"pool: {pool['pool_size']} devices, "
+          f"{pool.get('devices_free', '?')} free, "
+          f"{pool.get('jobs_running', '?')} running / "
+          f"{pool.get('jobs_waiting', '?')} waiting "
+          f"(tick {pool.get('ticks', '?')}; "
+          f"evictions={c.get('evictions', 0)} shrinks={c.get('shrinks', 0)} "
+          f"readmits={c.get('readmits', 0)})")
+    rows = fstate.list_job_records(args.fleet_dir)
+    if rows:
+        print(f"{'job':<20} {'status':<8} {'prio':>4} {'world':>5} "
+              f"{'applied':>8} {'restarts':>8} devices")
+        for r in rows:
+            print(f"{r['job_id']:<20} {r['status']:<8} "
+                  f"{r.get('priority', 0):>4} {r.get('world', 0):>5} "
+                  f"{r.get('applied_updates', 0):>8} "
+                  f"{r.get('restarts', 0):>8} {r.get('devices', [])}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("submit", help="queue one JSON job spec")
+    ps.add_argument("--fleet_dir", type=str, required=True)
+    ps.add_argument("--spec", type=str, required=True,
+                    help="path to the JSON job spec ('-' = stdin)")
+
+    pr = sub.add_parser("run", help="the scheduler process")
+    pr.add_argument("--fleet_dir", type=str, required=True)
+    pr.add_argument("--devices", type=int, required=True,
+                    help="device-pool size the placements bin-pack")
+    pr.add_argument("--interval", type=float, default=5.0,
+                    help="seconds between scheduler ticks")
+    pr.add_argument("--max_ticks", type=int, default=None,
+                    help="stop after this many ticks (default: run forever)")
+    pr.add_argument("--until_idle", action="store_true",
+                    help="exit once every admitted job finished and the "
+                         "queue is empty")
+    pr.add_argument("--max_restarts", type=int, default=3,
+                    help="per-job crash budget (preemptions are free)")
+    pr.add_argument("--grace", type=float, default=60.0,
+                    help="seconds after a (re)start before heartbeat "
+                         "verdicts apply")
+    pr.add_argument("--max_age", type=float, default=120.0,
+                    help="heartbeat staleness bound for the health verdict")
+    pr.add_argument("--term_timeout", type=float, default=30.0,
+                    help="seconds to wait for a SIGTERM'd job's emergency "
+                         "save before SIGKILL")
+
+    pt = sub.add_parser("status", help="print pool + per-job records")
+    pt.add_argument("--fleet_dir", type=str, required=True)
+
+    args = p.parse_args(argv)
+    if args.cmd == "submit":
+        return run_submit(args)
+    if args.cmd == "run":
+        return run_run(args)
+    return run_status(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

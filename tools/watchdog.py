@@ -35,6 +35,12 @@ child's last exit code (or 1).  The restart budget is CONSECUTIVE — any
 healthy check refills it — so a long-lived run that crashes once a day is
 not eventually abandoned.
 
+A child that exits ``PREEMPT_EXIT`` (75) respawns immediately — no
+backoff, no budget burn — but that free pass is rate-capped: more than
+``--max_preempts`` preempt exits within ``--preempt_window`` seconds is a
+preempt STORM (a scheduler or broken environment preempting in a tight
+loop) and is handled like any unhealthy verdict.
+
 With ``--elastic_dir`` the relaunch is ELASTIC-aware: every spawn exports
 ``TCDP_RESTART_COUNT`` (the child's heartbeat incarnation) plus, when the
 rendezvous directory holds a committed world epoch, the epoch and
@@ -57,6 +63,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import collections
 import os
 import subprocess
 import sys
@@ -64,7 +71,8 @@ import time
 from typing import Callable, List, Optional
 
 from tpu_compressed_dp.utils.resilience import (PREEMPT_EXIT, check_heartbeat,
-                                                read_heartbeat)
+                                                read_heartbeat,
+                                                spawn_supervised)
 
 
 def run_check(args) -> int:
@@ -121,7 +129,9 @@ def supervise(spawn: Callable[[], "subprocess.Popen"],
               kill: Callable[..., None] = kill_child,
               log: Callable[[str], None] = print,
               max_checks: Optional[int] = None,
-              preempt_exit_code: Optional[int] = PREEMPT_EXIT) -> int:
+              preempt_exit_code: Optional[int] = PREEMPT_EXIT,
+              max_preempts: Optional[int] = 8,
+              preempt_window_s: float = 600.0) -> int:
     """The relaunch decision loop, with every side effect injectable so the
     unit test can drive it against a fake child and a scripted check
     sequence (tests/test_observability.py::TestWatchdogRelaunch).
@@ -139,33 +149,57 @@ def supervise(spawn: Callable[[], "subprocess.Popen"],
     ``backoff_s * 2^consecutive`` capped at ``backoff_cap_s``, respawn, and
     re-enter the grace period (no checks for ``grace_s`` — a fresh process
     needs time to write its first heartbeat).
+
+    **Preempt-storm guard**: free preempt respawns are rate-capped — more
+    than ``max_preempts`` preempt exits inside a sliding
+    ``preempt_window_s`` window stops counting as "the environment's
+    fault" (a scheduler or broken env preempting in a tight loop would
+    otherwise respawn forever, never touching the budget) and falls
+    through to the unhealthy path: consecutive budget, capped backoff,
+    give-up with the child's exit code.  ``max_preempts=None`` disables
+    the cap.  The window clock is the supervisor's own cumulative slept
+    time (deterministic under the injected ``sleep``).
     """
     child = spawn()
     consecutive = 0
     grace_until = grace_s  # relative clock: ticks since (re)launch
     ticks_since_launch = 0.0
+    slept = 0.0  # cumulative slept time: the storm window's clock
+    preempts: "collections.deque[float]" = collections.deque()
     checks = 0
     try:
         while True:
             sleep(interval_s)
+            slept += interval_s
             ticks_since_launch += interval_s
             if child.poll() is not None and child.returncode == 0:
                 log("watchdog: child exited cleanly; supervision done")
                 return 0
+            storm = False
             if (child.poll() is not None and preempt_exit_code is not None
                     and child.returncode == preempt_exit_code):
-                # preemption is not a failure: the child cut an emergency
-                # checkpoint and exited deliberately.  Respawn NOW — no
-                # backoff, no consecutive-budget burn, no health check
-                # consumed (the freed capacity may already be back)
-                log(f"watchdog: child preempted (exit {preempt_exit_code}); "
-                    "relaunching immediately")
-                child = spawn()
-                ticks_since_launch = 0.0
-                continue
-            if ticks_since_launch < grace_until:
+                preempts.append(slept)
+                while preempts and slept - preempts[0] > preempt_window_s:
+                    preempts.popleft()
+                if max_preempts is None or len(preempts) <= max_preempts:
+                    # preemption is not a failure: the child cut an
+                    # emergency checkpoint and exited deliberately.
+                    # Respawn NOW — no backoff, no consecutive-budget
+                    # burn, no health check consumed (the freed capacity
+                    # may already be back)
+                    log(f"watchdog: child preempted "
+                        f"(exit {preempt_exit_code}); relaunching "
+                        "immediately")
+                    child = spawn()
+                    ticks_since_launch = 0.0
+                    continue
+                storm = True
+                log(f"watchdog: preempt storm: {len(preempts)} preempt "
+                    f"exits within {preempt_window_s:g}s (cap "
+                    f"{max_preempts}) — treating as unhealthy")
+            if not storm and ticks_since_launch < grace_until:
                 continue  # fresh (re)launch: let the heartbeat appear
-            rc = check()
+            rc = 1 if storm else check()
             checks += 1
             if rc == 0:
                 consecutive = 0
@@ -186,6 +220,7 @@ def supervise(spawn: Callable[[], "subprocess.Popen"],
                     "backoff")
                 kill(child)
                 sleep(delay)
+                slept += delay
                 child = spawn()
                 consecutive += 1
                 ticks_since_launch = 0.0
@@ -212,35 +247,28 @@ def run_relaunch(args, cmd: List[str]) -> int:
     launches = {"n": int(os.environ.get("TCDP_RESTART_COUNT", "0") or 0)}
 
     def spawn():
-        # TCDP_RESTART_COUNT seeds the child Heartbeat's incarnation: each
-        # respawn gets a strictly larger value, so a relaunched worker's
-        # heartbeats are distinguishable from the stale file its previous
-        # life left behind (utils/resilience.Heartbeat, train/elastic.py)
-        env = dict(os.environ, TCDP_RESTART_COUNT=str(launches["n"]))
-        if getattr(args, "elastic_dir", None):
-            # rejoin hint: when the rendezvous directory already holds a
-            # committed world epoch, the survivors are still training —
-            # export it so the child lands in THAT world's join barrier
-            # (train/rendezvous.maybe_rejoin_from_env) instead of forming
-            # a fresh single-process world
-            from tpu_compressed_dp.train.rendezvous import (DIR_ENV,
-                                                            export_env,
-                                                            read_epoch)
-            env[DIR_ENV] = args.elastic_dir
-            rec = read_epoch(args.elastic_dir)
-            if rec is not None:
-                export_env(env, rec)
-                print(f"watchdog: rejoin hint: world epoch {rec['epoch']} "
-                      f"@ {rec.get('address')}")
+        # spawn_supervised composes the child env: TCDP_RESTART_COUNT
+        # seeds the child Heartbeat's incarnation (strictly larger per
+        # respawn, so a relaunched worker's heartbeats are
+        # distinguishable from its previous life's stale file), and with
+        # --elastic_dir the committed-epoch rejoin hint lands the child
+        # in the RUNNING world's join barrier
+        # (train/rendezvous.maybe_rejoin_from_env) instead of a fresh one
+        child = spawn_supervised(
+            cmd, restart_count=launches["n"],
+            elastic_dir=getattr(args, "elastic_dir", None),
+            log=lambda s: print(f"watchdog: {s}"))
         launches["n"] += 1
         print(f"watchdog: launching: {' '.join(cmd)}")
-        return subprocess.Popen(cmd, env=env)
+        return child
 
     return supervise(
         spawn, lambda: run_check(args),
         interval_s=args.interval, grace_s=args.grace,
         max_relaunches=args.max_relaunches,
-        backoff_s=args.backoff, backoff_cap_s=args.backoff_cap)
+        backoff_s=args.backoff, backoff_cap_s=args.backoff_cap,
+        max_preempts=(None if args.max_preempts <= 0 else args.max_preempts),
+        preempt_window_s=args.preempt_window)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -280,6 +308,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "per consecutive restart)")
     p.add_argument("--backoff_cap", type=float, default=300.0,
                    help="relaunch mode: backoff ceiling")
+    p.add_argument("--max_preempts", type=int, default=8,
+                   help="relaunch mode: preempt-storm guard — more than "
+                        "this many PREEMPT_EXIT respawns inside "
+                        "--preempt_window seconds counts as unhealthy "
+                        "(consecutive budget + backoff) instead of a free "
+                        "immediate relaunch; <= 0 disables the cap")
+    p.add_argument("--preempt_window", type=float, default=600.0,
+                   help="relaunch mode: sliding window (seconds of "
+                        "supervisor slept time) for --max_preempts")
     p.add_argument("--elastic_dir", type=str, default=None,
                    help="relaunch mode: the run's shared rendezvous/gossip "
                         "directory (harness --elastic_dir); exports the "

@@ -63,6 +63,12 @@ REPLAY_DETERMINISTIC_MODULES = (
     "tpu_compressed_dp/control/rungs.py",
     "tpu_compressed_dp/control/signals.py",
     "tpu_compressed_dp/control/state.py",
+    # the fleet decision loop: admission order, placement, preemption and
+    # the records/events they produce must replay from the same snapshot
+    # (clocks are injected, timestamps ride in via the scheduler's wall)
+    "tpu_compressed_dp/fleet/spec.py",
+    "tpu_compressed_dp/fleet/placement.py",
+    "tpu_compressed_dp/fleet/scheduler.py",
 )
 
 #: modules that write records other processes read over shared storage —
@@ -73,12 +79,15 @@ SHARED_DIR_MODULES = (
     "tpu_compressed_dp/utils/resilience.py",
     "tpu_compressed_dp/utils/checkpoint.py",
     "tpu_compressed_dp/obs/export.py",
+    # fleet queue/job/pool records: multi-process readers (operator CLI,
+    # dashboards) over the shared fleet dir
+    "tpu_compressed_dp/fleet/state.py",
 )
 
 #: registry-governed stat-key families (TCDP103); literals shaped
 #: "<family>/<name>" with these families must be declared
 STAT_FAMILIES = ("comm", "guard", "elastic", "ckpt", "throughput", "time",
-                 "net", "control")
+                 "net", "control", "fleet")
 STAT_KEY_RE = re.compile(r"^(?:%s)/[a-z0-9_]+$" % "|".join(STAT_FAMILIES))
 
 _WALLCLOCK_CALLS = frozenset({

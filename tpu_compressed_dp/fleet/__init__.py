@@ -1,0 +1,32 @@
+"""Fleet control plane: many jobs, one device pool.
+
+Turns the single-job resilience primitives (watchdog supervision,
+SIGTERM -> emergency save -> exit 75 preemption, elastic shrink/readmit,
+heartbeat verdicts) into scheduling primitives: an admission queue of JSON
+job specs over a shared device pool, priority preemption via
+shrink-before-evict, bin-packing freed slices back into waiting or
+shrunken jobs, and per-job Prometheus/JSONL observability.
+
+  * :mod:`~tpu_compressed_dp.fleet.spec` — :class:`JobSpec` (the queue
+    currency), strict validation, JSON round-trip.
+  * :mod:`~tpu_compressed_dp.fleet.state` — the shared-dir file protocol
+    (atomic tmp+``os.replace`` writes, tolerant reads).
+  * :mod:`~tpu_compressed_dp.fleet.placement` — the pure planner
+    (:func:`plan`) and the :class:`DevicePool` slice allocator.
+  * :mod:`~tpu_compressed_dp.fleet.scheduler` — :class:`FleetScheduler`,
+    the tick loop driving a :class:`JobController` (subprocess controller
+    in ``tools/fleet.py``; in-process elastic controller in the chaos
+    drill).
+"""
+
+from tpu_compressed_dp.fleet.placement import (DevicePool, Evict, Grow,
+                                               Place, Shrink, Slot, Waiting,
+                                               plan)
+from tpu_compressed_dp.fleet.scheduler import FleetScheduler, JobController
+from tpu_compressed_dp.fleet.spec import JobSpec, SpecError
+
+__all__ = [
+    "JobSpec", "SpecError",
+    "Slot", "Waiting", "Shrink", "Evict", "Place", "Grow", "plan",
+    "DevicePool", "FleetScheduler", "JobController",
+]

@@ -1,0 +1,210 @@
+"""Placement: the pure half of the fleet scheduler.
+
+``plan()`` maps one snapshot of the pool (running slots + the waiting
+queue) to an ordered action list — shrinks first, then evictions, then
+placements, then growth — with no I/O, no clocks, and no randomness, so
+the decision loop is replay-deterministic (tcdp-lint TCDP101) and every
+preemption scenario is unit-testable as a plain function call.
+
+Policy, in decreasing order of preference (cheapest capacity first):
+
+  1. **Waiting jobs are served by (priority desc, submit seq asc).**  A
+     job places as soon as ``free >= min_world``, at ``min(max_world,
+     free)`` devices.
+  2. **Shrink before evict.**  To fit a waiting job, strictly
+     lower-priority ELASTIC slots give up ``world - min_world`` devices
+     through the readmit barrier (lowest priority first, latest admitted
+     first) — a shrink costs one remesh, an eviction costs a full
+     save/restore cycle.
+  3. **Evict as the last resort.**  Still short, strictly lower-priority
+     slots are evicted (lowest priority first, latest admitted first) via
+     the harness's SIGTERM -> emergency save -> exit 75 path; the
+     scheduler requeues them with their ORIGINAL submit seq, so they
+     reclaim capacity ahead of later arrivals once the pressure clears.
+  4. **No growth while anyone waits.**  Freed capacity belongs to the
+     waiting queue first; only an empty queue lets running elastic slots
+     grow back toward ``max_world`` (priority desc, earliest admitted
+     first) — that growth is the readmit half of the shrink in (2).
+
+Equal priority never preempts equal priority: a tie is broken by arrival
+only inside the waiting queue, not by taking a peer's devices.
+
+:class:`DevicePool` is the slice allocator the scheduler pairs with the
+plan: contiguous-first-fit device ids (falling back to the lowest free
+ids when fragmented), so placements map cleanly onto mesh slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+__all__ = ["Slot", "Waiting", "Shrink", "Evict", "Place", "Grow", "Action",
+           "plan", "DevicePool"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Slot:
+    """One running job as the planner sees it.  ``elastic`` means the
+    CONTROLLER can resize it in place (an in-process drill job can; a v1
+    subprocess job cannot — it only ever places or evicts whole)."""
+
+    job_id: str
+    priority: int
+    world: int
+    min_world: int
+    max_world: int
+    seq: int
+    elastic: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Waiting:
+    """One admission-queue entry (``resume`` marks an evicted job coming
+    back: it keeps its original ``seq``, so it outranks later arrivals at
+    equal priority)."""
+
+    job_id: str
+    priority: int
+    min_world: int
+    max_world: int
+    seq: int
+    resume: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Shrink:
+    job_id: str
+    world: int  # new (smaller) world
+
+
+@dataclasses.dataclass(frozen=True)
+class Evict:
+    job_id: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Place:
+    job_id: str
+    world: int
+    resume: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Grow:
+    job_id: str
+    world: int  # new (larger) world
+
+
+Action = Union[Shrink, Evict, Place, Grow]
+
+
+def plan(pool_size: int, running: Sequence[Slot],
+         waiting: Sequence[Waiting]) -> List[Action]:
+    """One tick's decisions over a snapshot; see the module docstring for
+    the policy.  The returned actions are ordered for execution: every
+    Shrink/Evict lands before the Place it funds."""
+    slots: Dict[str, Slot] = {s.job_id: s for s in running}
+    free = int(pool_size) - sum(s.world for s in slots.values())
+    actions: List[Action] = []
+    queue = sorted(waiting, key=lambda w: (-w.priority, w.seq, w.job_id))
+    placed_all = True
+    for w in queue:
+        need = int(w.min_world)
+        if need > int(pool_size):
+            # validated at admission; defensive here so one impossible spec
+            # can never wedge the queue for everyone behind it
+            placed_all = False
+            continue
+        if free < need:
+            # (2) shrink strictly-lower-priority elastic slots, cheapest
+            # victims first: lowest priority, then latest admitted
+            for s in sorted(slots.values(), key=lambda s: (s.priority, -s.seq)):
+                if free >= need:
+                    break
+                if s.priority >= w.priority or not s.elastic:
+                    continue
+                gain = s.world - s.min_world
+                if gain <= 0:
+                    continue
+                give = min(gain, need - free)
+                shrunk = dataclasses.replace(s, world=s.world - give)
+                slots[s.job_id] = shrunk
+                actions.append(Shrink(s.job_id, shrunk.world))
+                free += give
+        if free < need:
+            # (3) evict, same victim order; an already-shrunk slot frees
+            # only its shrunken world
+            for s in sorted(slots.values(), key=lambda s: (s.priority, -s.seq)):
+                if free >= need:
+                    break
+                if s.priority >= w.priority:
+                    continue
+                del slots[s.job_id]
+                actions.append(Evict(s.job_id))
+                free += s.world
+        if free < need:
+            placed_all = False  # nobody evictable is big enough; wait
+            continue
+        world = min(int(w.max_world), free)
+        actions.append(Place(w.job_id, world, resume=w.resume))
+        slots[w.job_id] = Slot(w.job_id, w.priority, world, w.min_world,
+                               w.max_world, w.seq)
+        free -= world
+    if placed_all and not [a for a in actions if isinstance(a, Evict)]:
+        # (4) growth = the readmit half of an earlier shrink; an eviction
+        # this tick means its victim requeues next tick — capacity is
+        # already spoken for, so growth waits a tick too
+        for s in sorted(slots.values(), key=lambda s: (-s.priority, s.seq)):
+            if free <= 0:
+                break
+            if not s.elastic or s.world >= s.max_world:
+                continue
+            take = min(s.max_world - s.world, free)
+            grown = dataclasses.replace(s, world=s.world + take)
+            slots[s.job_id] = grown
+            actions.append(Grow(s.job_id, grown.world))
+            free -= take
+    return actions
+
+
+class DevicePool:
+    """Device-id slice allocator: contiguous first-fit, lowest-ids
+    fallback when fragmented.  Purely bookkeeping — the controller maps
+    ids onto real devices (``jax.devices()[i]`` in the drill)."""
+
+    def __init__(self, pool_size: int):
+        self.pool_size = int(pool_size)
+        self._free = list(range(self.pool_size))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> Tuple[int, ...]:
+        n = int(n)
+        if n <= 0 or n > len(self._free):
+            raise ValueError(
+                f"cannot allocate {n} devices ({len(self._free)} free of "
+                f"{self.pool_size})")
+        free = sorted(self._free)
+        run: Optional[Tuple[int, ...]] = None
+        for i in range(len(free) - n + 1):
+            window = free[i:i + n]
+            if window[-1] - window[0] == n - 1:
+                run = tuple(window)
+                break
+        ids = run if run is not None else tuple(free[:n])
+        for d in ids:
+            self._free.remove(d)
+        return ids
+
+    def release(self, ids: Sequence[int]) -> None:
+        for d in ids:
+            d = int(d)
+            if not (0 <= d < self.pool_size):
+                raise ValueError(f"device id {d} outside pool "
+                                 f"[0, {self.pool_size})")
+            if d in self._free:
+                raise ValueError(f"device id {d} double-released")
+            self._free.append(d)

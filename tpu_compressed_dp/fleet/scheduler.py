@@ -1,0 +1,357 @@
+"""The fleet scheduler: one decision loop over many supervised jobs.
+
+:class:`FleetScheduler` turns the repo's single-job resilience primitives
+into scheduling primitives.  Each ``tick()``:
+
+  1. **admit** — parse the shared-dir admission queue
+     (``fleet/state.py``), reject malformed or unplaceable specs with a
+     ``fleet_reject`` event, enqueue the rest (``fleet_admit``);
+  2. **poll** — ask the :class:`JobController` about every running job:
+     a clean exit finishes it (``fleet_finish``), a spontaneous
+     ``PREEMPT_EXIT`` requeues it for resume (``fleet_preempt`` — some
+     external agent preempted it; its emergency checkpoint makes that
+     cheap), an unhealthy heartbeat verdict or crash burns a restart
+     from its budget and requeues (``fleet_restart``) until the budget
+     is spent (``fleet_fail``);
+  3. **plan** — hand the snapshot to the pure planner
+     (``fleet/placement.py``) — shrink-before-evict priority preemption,
+     no growth while anyone waits;
+  4. **execute** — drive the controller through the plan, moving device
+     ids through the :class:`~tpu_compressed_dp.fleet.placement.DevicePool`
+     and emitting ``fleet_shrink`` / ``fleet_evict`` / ``fleet_place`` /
+     ``fleet_readmit`` events;
+  5. **export** — atomic per-job status records + pool record
+     (``fleet/state.py``) and per-job + pool Prometheus rollups
+     (``fleet/*`` metrics, ``job`` label — one file per job, so many jobs
+     share one textfile-collector dir without clobbering).
+
+All side effects go through the injected controller/events/wall/sleep, so
+multi-job preemption interleavings are unit-tested single-threaded with a
+scripted controller (tests/test_fleet.py); ``tools/fleet.py`` provides the
+real subprocess controller, the chaos drill an in-process elastic one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from tpu_compressed_dp.fleet import state as fstate
+from tpu_compressed_dp.fleet.placement import (DevicePool, Evict, Grow,
+                                               Place, Shrink, Slot, Waiting,
+                                               plan)
+from tpu_compressed_dp.fleet.spec import JobSpec
+from tpu_compressed_dp.obs.export import write_prometheus
+from tpu_compressed_dp.utils.resilience import PREEMPT_EXIT
+
+__all__ = ["JobController", "FleetScheduler"]
+
+
+class JobController:
+    """The scheduler's only way to touch a job — subclass per execution
+    substrate.  ``resizable`` advertises in-place shrink/grow support
+    (the in-process drill controller can remesh through the elastic
+    readmit barrier; the v1 subprocess controller places and evicts whole
+    jobs only)."""
+
+    resizable = False
+
+    def start(self, spec: JobSpec, world: int, devices: Tuple[int, ...],
+              *, resume: bool) -> None:
+        raise NotImplementedError
+
+    def evict(self, job_id: str) -> int:
+        """Preempt the job (SIGTERM -> emergency save); returns the exit
+        code — :data:`PREEMPT_EXIT` when the preempt path worked."""
+        raise NotImplementedError
+
+    def shrink(self, job_id: str, world: int) -> None:
+        raise NotImplementedError(f"{type(self).__name__} is not resizable")
+
+    def grow(self, job_id: str, world: int,
+             new_devices: Tuple[int, ...]) -> None:
+        raise NotImplementedError(f"{type(self).__name__} is not resizable")
+
+    def poll(self, job_id: str) -> Dict[str, Any]:
+        """Status snapshot: ``exit_code`` (None while running),
+        ``applied_updates`` (optional progress watermark), ``healthy``
+        (optional heartbeat verdict; False triggers a restart)."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class _Job:
+    spec: JobSpec
+    seq: int
+    status: str = "waiting"  # waiting | running | done | failed
+    world: int = 0
+    devices: Tuple[int, ...] = ()
+    applied: int = 0
+    restarts: int = 0
+    resume: bool = False
+    exit_code: Optional[int] = None
+
+
+class FleetScheduler:
+    """See the module docstring.  ``wall`` stamps shared-dir records and
+    events (injectable: a replayed tick writes byte-identical files);
+    ``max_restarts`` is the per-job CRASH budget — preemptions (evictions
+    and spontaneous ``PREEMPT_EXIT``) never burn it, mirroring the
+    watchdog's own preempt accounting."""
+
+    def __init__(self, fleet_dir: str, pool_size: int,
+                 controller: JobController, *,
+                 events=None,
+                 wall: Callable[[], float] = time.time,
+                 prom: bool = True,
+                 max_restarts: int = 3,
+                 log: Callable[[str], None] = print):
+        self.fleet_dir = fleet_dir
+        self.pool_size = int(pool_size)
+        self.controller = controller
+        self.events = events
+        self._wall = wall
+        self.prom = prom
+        self.max_restarts = int(max_restarts)
+        self.log = log
+        self.pool = DevicePool(self.pool_size)
+        self.jobs: Dict[str, _Job] = {}
+        self.counters: Dict[str, int] = {
+            "admits": 0, "rejects": 0, "placements": 0, "evictions": 0,
+            "shrinks": 0, "readmits": 0, "preemptions": 0, "restarts": 0,
+            "finishes": 0, "failures": 0}
+        self._seq = 0
+        self._ticks = 0
+
+    # ------------------------------------------------------------- events
+    def _emit(self, kind: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(kind, **fields)
+
+    # ------------------------------------------------------------- submit
+    def submit(self, spec: JobSpec) -> None:
+        """Operator-side enqueue (also what ``tools/fleet.py submit``
+        does, from another process)."""
+        fstate.submit_job(self.fleet_dir, spec, ts=self._wall())
+        self._emit("fleet_submit", job=spec.job_id, priority=spec.priority)
+
+    # -------------------------------------------------------------- admit
+    def _admit(self) -> None:
+        for spec, rec in fstate.pending_submissions(self.fleet_dir):
+            if spec is None:
+                job_id = rec.get("job_id", "?")
+                self.counters["rejects"] += 1
+                self._emit("fleet_reject", job=job_id, error=rec.get("error"))
+                self.log(f"fleet: reject {job_id}: {rec.get('error')}")
+                fstate.clear_submission(self.fleet_dir, job_id)
+                continue
+            error = None
+            if spec.min_world > self.pool_size:
+                error = (f"min_world {spec.min_world} exceeds the pool "
+                         f"({self.pool_size} devices)")
+            elif spec.job_id in self.jobs:
+                error = f"job_id {spec.job_id} already admitted"
+            if error is not None:
+                self.counters["rejects"] += 1
+                self._emit("fleet_reject", job=spec.job_id, error=error)
+                self.log(f"fleet: reject {spec.job_id}: {error}")
+                fstate.clear_submission(self.fleet_dir, spec.job_id)
+                continue
+            self.jobs[spec.job_id] = _Job(spec=spec, seq=self._seq)
+            self._seq += 1
+            self.counters["admits"] += 1
+            self._emit("fleet_admit", job=spec.job_id,
+                       priority=spec.priority, seq=self.jobs[spec.job_id].seq)
+            fstate.clear_submission(self.fleet_dir, spec.job_id)
+
+    # --------------------------------------------------------------- poll
+    def _release(self, job: _Job) -> None:
+        if job.devices:
+            self.pool.release(job.devices)
+        job.devices = ()
+        job.world = 0
+
+    def _poll_running(self) -> None:
+        for job in list(self.jobs.values()):
+            if job.status != "running":
+                continue
+            st = self.controller.poll(job.spec.job_id) or {}
+            if "applied_updates" in st:
+                job.applied = int(st["applied_updates"])
+            rc = st.get("exit_code")
+            if rc is None:
+                if st.get("healthy") is False:
+                    # wedged/stale per the heartbeat verdict: kill it and
+                    # requeue — the restart budget decides how long we try
+                    rc = self.controller.evict(job.spec.job_id)
+                    self.log(f"fleet: {job.spec.job_id} unhealthy; killed "
+                             f"(exit {rc})")
+                    self._fail_or_requeue(job, rc)
+                continue
+            rc = int(rc)
+            job.exit_code = rc
+            if rc == 0:
+                job.status = "done"
+                self._release(job)
+                self.counters["finishes"] += 1
+                self._emit("fleet_finish", job=job.spec.job_id,
+                           applied_updates=job.applied)
+            elif rc == PREEMPT_EXIT:
+                # not OUR eviction (those are synchronous in _execute) —
+                # an external preemption; resume costs seconds, not budget
+                self._release(job)
+                job.status = "waiting"
+                job.resume = True
+                self.counters["preemptions"] += 1
+                self._emit("fleet_preempt", job=job.spec.job_id,
+                           exit_code=rc)
+            else:
+                self._fail_or_requeue(job, rc)
+
+    def _fail_or_requeue(self, job: _Job, rc: Optional[int]) -> None:
+        self._release(job)
+        job.exit_code = rc
+        if job.restarts >= self.max_restarts:
+            job.status = "failed"
+            self.counters["failures"] += 1
+            self._emit("fleet_fail", job=job.spec.job_id, exit_code=rc,
+                       restarts=job.restarts)
+            return
+        job.restarts += 1
+        job.status = "waiting"
+        job.resume = True
+        self.counters["restarts"] += 1
+        self._emit("fleet_restart", job=job.spec.job_id, exit_code=rc,
+                   restart=job.restarts)
+
+    # ------------------------------------------------------------ execute
+    def _snapshot(self) -> Tuple[List[Slot], List[Waiting]]:
+        running, waiting = [], []
+        for job in self.jobs.values():
+            if job.status == "running":
+                running.append(Slot(
+                    job.spec.job_id, job.spec.priority, job.world,
+                    job.spec.min_world, job.spec.max_world, job.seq,
+                    elastic=self.controller.resizable and job.spec.elastic))
+            elif job.status == "waiting":
+                waiting.append(Waiting(
+                    job.spec.job_id, job.spec.priority, job.spec.min_world,
+                    job.spec.max_world, job.seq, resume=job.resume))
+        return running, waiting
+
+    def _execute(self, actions: Sequence) -> None:
+        for act in actions:
+            job = self.jobs[act.job_id]
+            if isinstance(act, Shrink):
+                freed = job.devices[act.world:]
+                job.devices = job.devices[:act.world]
+                job.world = act.world
+                self.controller.shrink(job.spec.job_id, act.world)
+                self.pool.release(freed)
+                self.counters["shrinks"] += 1
+                self._emit("fleet_shrink", job=job.spec.job_id,
+                           world=act.world, freed=list(freed))
+            elif isinstance(act, Evict):
+                rc = self.controller.evict(job.spec.job_id)
+                self._release(job)
+                job.status = "waiting"
+                job.resume = True
+                job.exit_code = rc
+                self.counters["evictions"] += 1
+                self._emit("fleet_evict", job=job.spec.job_id, exit_code=rc)
+                if rc != PREEMPT_EXIT:
+                    self.log(f"fleet: evicted {job.spec.job_id} exited "
+                             f"{rc}, not PREEMPT_EXIT({PREEMPT_EXIT}) — "
+                             "no emergency save?")
+            elif isinstance(act, Place):
+                devices = self.pool.allocate(act.world)
+                self.controller.start(job.spec, act.world, devices,
+                                      resume=act.resume)
+                job.status = "running"
+                job.world = act.world
+                job.devices = devices
+                job.resume = False
+                job.exit_code = None
+                self.counters["placements"] += 1
+                self._emit("fleet_place", job=job.spec.job_id,
+                           world=act.world, devices=list(devices),
+                           resume=act.resume)
+            elif isinstance(act, Grow):
+                new = self.pool.allocate(act.world - job.world)
+                self.controller.grow(job.spec.job_id, act.world, new)
+                job.devices = job.devices + new
+                job.world = act.world
+                self.counters["readmits"] += 1
+                self._emit("fleet_readmit", job=job.spec.job_id,
+                           world=act.world, devices=list(new))
+
+    # ------------------------------------------------------------- export
+    def _job_metrics(self, job: _Job) -> Dict[str, float]:
+        return {"fleet/world": float(job.world),
+                "fleet/priority": float(job.spec.priority),
+                "fleet/applied_updates": float(job.applied),
+                "fleet/restarts": float(job.restarts)}
+
+    def _export(self) -> None:
+        ts = self._wall()
+        for job in self.jobs.values():
+            fstate.write_job_record(self.fleet_dir, {
+                "job_id": job.spec.job_id, "status": job.status,
+                "priority": job.spec.priority, "seq": job.seq,
+                "world": job.world, "devices": list(job.devices),
+                "applied_updates": job.applied, "restarts": job.restarts,
+                "resume": job.resume, "exit_code": job.exit_code,
+                "ts": ts})
+        running = [j for j in self.jobs.values() if j.status == "running"]
+        waiting = [j for j in self.jobs.values() if j.status == "waiting"]
+        fstate.write_pool_record(self.fleet_dir, {
+            "pool_size": self.pool_size, "ticks": self._ticks,
+            "devices_free": self.pool.free_count,
+            "jobs_running": len(running), "jobs_waiting": len(waiting),
+            "counters": dict(self.counters), "ts": ts})
+        if not self.prom:
+            return
+        pdir = fstate.prom_dir(self.fleet_dir)
+        for job in self.jobs.values():
+            write_prometheus(
+                self._job_metrics(job),
+                f"{pdir}/{job.spec.job_id}.fleet.prom",
+                labels={"job": job.spec.job_id})
+        write_prometheus(
+            {"fleet/jobs_running": float(len(running)),
+             "fleet/jobs_waiting": float(len(waiting)),
+             "fleet/devices_free": float(self.pool.free_count),
+             "fleet/evictions": float(self.counters["evictions"]),
+             "fleet/shrinks": float(self.counters["shrinks"]),
+             "fleet/readmits": float(self.counters["readmits"])},
+            f"{pdir}/fleet.prom")
+
+    # --------------------------------------------------------------- tick
+    def tick(self) -> None:
+        self._admit()
+        self._poll_running()
+        running, waiting = self._snapshot()
+        self._execute(plan(self.pool_size, running, waiting))
+        self._export()
+        self._ticks += 1
+
+    def idle(self) -> bool:
+        """True when nothing is running or waiting (the queue may still
+        receive submissions — ``run`` keeps polling unless told to stop)."""
+        return not any(j.status in ("running", "waiting")
+                       for j in self.jobs.values())
+
+    def run(self, *, interval_s: float = 1.0,
+            sleep: Callable[[float], None] = time.sleep,
+            max_ticks: Optional[int] = None,
+            until_idle: bool = False) -> int:
+        """Tick until ``max_ticks`` (None = forever) or — with
+        ``until_idle`` — until every admitted job has finished AND the
+        queue is empty.  Returns the tick count."""
+        while max_ticks is None or self._ticks < max_ticks:
+            self.tick()
+            if until_idle and self.idle():
+                break
+            sleep(interval_s)
+        return self._ticks

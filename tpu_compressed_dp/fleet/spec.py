@@ -1,0 +1,117 @@
+"""Job specs — the admission-queue currency of the fleet control plane.
+
+A :class:`JobSpec` is everything the scheduler needs to place, supervise,
+preempt, and resume one training job: an identifier (also the namespace
+prefix for its heartbeat/Prometheus/event files — see
+``obs.export.job_scoped_path``), a priority, an elastic world range
+(``min_world <= world <= max_world`` devices; ``min_world == max_world``
+pins the job), the harness command, and the checkpoint directory its
+eviction path saves into (the PR-8 SIGTERM -> emergency save -> exit 75
+contract is what makes eviction cost seconds instead of a lost run).
+
+Specs round-trip through JSON because the admission queue IS files: an
+operator (or another service) drops ``tools/fleet.py submit`` records into
+``<fleet_dir>/queue/`` and the scheduler admits them on its next tick.
+Validation is strict at both ends — a malformed spec must bounce at submit
+time (or be rejected with a ``fleet_reject`` event at admit time), never
+wedge the decision loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+__all__ = ["JobSpec", "SpecError", "JOB_ID_RE"]
+
+#: job ids double as file-name prefixes (``job.<id>.json``,
+#: ``<id>.metrics.prom``) — keep them path- and label-safe
+JOB_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+class SpecError(ValueError):
+    """A job spec that must not enter the admission queue."""
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One job's contract with the fleet.
+
+    ``priority`` orders admission and preemption: a higher-priority arrival
+    may shrink (elastic jobs, down to ``min_world``) or evict (via the
+    harness's preempt path) strictly lower-priority jobs to fit.  Ties
+    never preempt each other on priority alone — arrival order breaks them,
+    latest admitted evicted first.
+
+    ``target_updates`` is the job's completion horizon in APPLIED updates
+    (the same counter the step guard and control plane key on); None means
+    "runs until its command exits 0".  ``checkpoint_dir`` names where the
+    eviction-time emergency save lands and where a re-placed job resumes
+    from — a job without one is still schedulable, but eviction loses its
+    progress since the operator's own last save.
+    """
+
+    job_id: str
+    command: Tuple[str, ...]
+    priority: int = 0
+    min_world: int = 1
+    max_world: int = 1
+    target_updates: Optional[int] = None
+    checkpoint_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if not JOB_ID_RE.match(self.job_id or ""):
+            raise SpecError(
+                f"job_id {self.job_id!r} must match {JOB_ID_RE.pattern} "
+                "(it prefixes heartbeat/prom/event file names)")
+        object.__setattr__(self, "command", tuple(str(c) for c in self.command))
+        if not self.command:
+            raise SpecError(f"job {self.job_id}: empty command")
+        if not (1 <= int(self.min_world) <= int(self.max_world)):
+            raise SpecError(
+                f"job {self.job_id}: need 1 <= min_world <= max_world, got "
+                f"[{self.min_world}, {self.max_world}]")
+        if self.target_updates is not None and int(self.target_updates) < 1:
+            raise SpecError(
+                f"job {self.job_id}: target_updates must be >= 1 or None")
+
+    @property
+    def elastic(self) -> bool:
+        """True when the world range is a real range — the job can absorb a
+        shrink (and later a readmit/grow) instead of an eviction."""
+        return int(self.min_world) < int(self.max_world)
+
+    def to_json(self) -> Dict[str, Any]:
+        rec = dataclasses.asdict(self)
+        rec["command"] = list(self.command)
+        return rec
+
+    @classmethod
+    def from_json(cls, rec: Dict[str, Any]) -> "JobSpec":
+        if not isinstance(rec, dict):
+            raise SpecError(f"job spec must be a JSON object, got {type(rec)}")
+        unknown = set(rec) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise SpecError(f"unknown job-spec fields: {sorted(unknown)}")
+        command = rec.get("command") or ()
+        if isinstance(command, str) or not isinstance(command, Sequence):
+            raise SpecError("command must be a list of argv strings")
+        kw = dict(rec)
+        kw["command"] = tuple(command)
+        for field in ("priority", "min_world", "max_world"):
+            if field in kw:
+                kw[field] = int(kw[field])
+        if kw.get("target_updates") is not None:
+            kw["target_updates"] = int(kw["target_updates"])
+        return cls(**kw)
+
+    @classmethod
+    def parse(cls, text: str) -> "JobSpec":
+        """Parse a JSON document (the ``tools/fleet.py submit`` payload)."""
+        try:
+            rec = json.loads(text)
+        except ValueError as e:
+            raise SpecError(f"job spec is not valid JSON: {e}") from e
+        return cls.from_json(rec)

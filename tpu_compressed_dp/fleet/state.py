@@ -1,0 +1,171 @@
+"""Fleet shared-dir protocol: the files a fleet is made of.
+
+Same discipline as ``train/rendezvous.py`` — every record is one JSON file
+written to a pid-unique ``*.tmp`` sibling and ``os.replace``d into place
+(readers on shared storage never see a torn record), every read is
+tolerant (missing/truncated/foreign content degrades to ``None``, never an
+exception out of the decision loop).  This module holds NO clocks: callers
+pass timestamps in (the scheduler's injectable ``wall``), so a replayed
+tick writes byte-identical records.
+
+Layout under one ``fleet_dir``::
+
+    queue/submit.<job_id>.json   admission queue (tools/fleet.py submit)
+    jobs/job.<job_id>.json       per-job status record (scheduler-owned)
+    pool.json                    pool size + counters (scheduler-owned)
+    fleet.events.jsonl           fleet_* JSONL event stream (append-only)
+    prom/<job_id>.fleet.prom     per-job Prometheus rollup
+    prom/fleet.prom              pool-level rollup
+
+The queue is multi-writer (any operator may submit), everything else is
+single-writer (the scheduler process) multi-reader (``tools/fleet.py
+status``, dashboards, tests).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpu_compressed_dp.fleet.spec import JobSpec, SpecError
+
+__all__ = [
+    "queue_dir", "jobs_dir", "prom_dir", "events_path", "pool_path",
+    "submit_job", "pending_submissions", "clear_submission",
+    "write_job_record", "read_job_record", "list_job_records",
+    "write_pool_record", "read_pool_record",
+]
+
+
+def _read_json(path: str) -> Optional[dict]:
+    """Tolerant read: None for missing/torn/foreign content (contract of
+    ``utils.resilience.read_heartbeat`` — a reader retries next tick)."""
+    try:
+        with open(path, "rb") as f:
+            rec = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def _write_json(path: str, rec: dict) -> str:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
+    return path
+
+
+def queue_dir(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, "queue")
+
+
+def jobs_dir(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, "jobs")
+
+
+def prom_dir(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, "prom")
+
+
+def events_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, "fleet.events.jsonl")
+
+
+def pool_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, "pool.json")
+
+
+# ------------------------------------------------------------ admission queue
+
+def _submit_path(fleet_dir: str, job_id: str) -> str:
+    return os.path.join(queue_dir(fleet_dir), f"submit.{job_id}.json")
+
+
+def submit_job(fleet_dir: str, spec: JobSpec, *, ts: float) -> str:
+    """Drop one spec into the admission queue (operator side).  One pending
+    submission per job_id — resubmitting before admission replaces it."""
+    os.makedirs(queue_dir(fleet_dir), exist_ok=True)
+    return _write_json(_submit_path(fleet_dir, spec.job_id),
+                       {"spec": spec.to_json(), "ts": float(ts)})
+
+
+def pending_submissions(fleet_dir: str) -> List[Tuple[JobSpec, dict]]:
+    """Parse the queue, oldest first (submit ts, then job_id — both come
+    from the record, so admission order replays).  Malformed specs are
+    returned with ``spec=None`` inside the raw record under ``"error"`` so
+    the scheduler can reject them visibly instead of looping over them."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(queue_dir(fleet_dir),
+                                              "submit.*.json"))):
+        m = re.search(r"submit\.(.+)\.json$", os.path.basename(path))
+        rec = _read_json(path)
+        if m is None or rec is None:
+            continue  # torn/in-flight write: picked up next tick
+        try:
+            spec = JobSpec.from_json(rec.get("spec"))
+            if spec.job_id != m.group(1):
+                raise SpecError(
+                    f"queue file {os.path.basename(path)} names job "
+                    f"{spec.job_id!r}")
+        except SpecError as e:
+            out.append((None, {**rec, "job_id": m.group(1), "error": str(e)}))
+            continue
+        out.append((spec, rec))
+    out.sort(key=lambda sr: (float(sr[1].get("ts", 0.0)),
+                             sr[0].job_id if sr[0] else sr[1]["job_id"]))
+    return out
+
+
+def clear_submission(fleet_dir: str, job_id: str) -> None:
+    try:
+        os.remove(_submit_path(fleet_dir, job_id))
+    except OSError:
+        pass
+
+
+# ------------------------------------------------------------- job records
+
+def _job_path(fleet_dir: str, job_id: str) -> str:
+    return os.path.join(jobs_dir(fleet_dir), f"job.{job_id}.json")
+
+
+def write_job_record(fleet_dir: str, rec: Dict[str, Any]) -> str:
+    """Scheduler-owned per-job status record (``job_id`` keys the file)."""
+    os.makedirs(jobs_dir(fleet_dir), exist_ok=True)
+    return _write_json(_job_path(fleet_dir, str(rec["job_id"])), rec)
+
+
+def read_job_record(fleet_dir: str, job_id: str) -> Optional[dict]:
+    rec = _read_json(_job_path(fleet_dir, job_id))
+    if rec is None or "job_id" not in rec or "status" not in rec:
+        return None
+    return rec
+
+
+def list_job_records(fleet_dir: str) -> List[dict]:
+    """All readable job records, sorted by job_id (``fleet.py status``)."""
+    out = []
+    for path in glob.glob(os.path.join(jobs_dir(fleet_dir), "job.*.json")):
+        rec = _read_json(path)
+        if rec is not None and "job_id" in rec and "status" in rec:
+            out.append(rec)
+    out.sort(key=lambda r: str(r["job_id"]))
+    return out
+
+
+# ------------------------------------------------------------- pool record
+
+def write_pool_record(fleet_dir: str, rec: Dict[str, Any]) -> str:
+    os.makedirs(fleet_dir, exist_ok=True)
+    return _write_json(pool_path(fleet_dir), rec)
+
+
+def read_pool_record(fleet_dir: str) -> Optional[dict]:
+    rec = _read_json(pool_path(fleet_dir))
+    if rec is None or "pool_size" not in rec:
+        return None
+    return rec

@@ -38,9 +38,11 @@ from tpu_compressed_dp.harness.loop import (add_adaptive_args,
                                             build_elastic, build_robustness,
                                             control_summary,
                                             elastic_distributed_init,
+                                            job_scoped,
                                             make_event_stream, make_heartbeat,
                                             make_preemption, preempt_exit,
-                                            profile_trace, train_epoch)
+                                            profile_trace, prom_labels,
+                                            train_epoch)
 from tpu_compressed_dp.models import alexnet as alexnet_mod
 from tpu_compressed_dp.models import resnet9 as resnet9_mod
 from tpu_compressed_dp.models import vgg as vgg_mod
@@ -668,7 +670,8 @@ def run(args) -> dict:
                      **timeline.snapshot(),
                      **(ckpt.metrics() if ckpt is not None else {}),
                      **(el.metrics() if el is not None else {})},
-                    args.prom, labels={"harness": "dawn"})
+                    job_scoped(args, args.prom),
+                    labels=prom_labels(args, harness="dawn"))
             if rank0:
                 table.append(summary)
                 tsv.append(summary)

@@ -52,9 +52,11 @@ from tpu_compressed_dp.harness.loop import (
     build_robustness,
     control_summary,
     elastic_distributed_init,
+    job_scoped,
     make_event_stream,
     make_heartbeat,
     make_preemption,
+    prom_labels,
     comm_summary,
     guard_summary,
     pad_batch,
@@ -694,7 +696,8 @@ def run(args) -> Dict[str, float]:
                      **guard_last, **control_stats, **timeline.snapshot(),
                      **(ckpt.metrics() if ckpt is not None else {}),
                      **(el.metrics() if el is not None else {})},
-                    args.prom, labels={"harness": "imagenet"})
+                    job_scoped(args, args.prom),
+                    labels=prom_labels(args, harness="imagenet"))
             # tensorboard: x-axis = cumulative examples (`logger.py:24-34`);
             # namespaces mirror the reference (losses/ times/ net/)
             tb.update_examples_count(examples)

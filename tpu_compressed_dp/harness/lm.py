@@ -316,10 +316,12 @@ def run(args) -> Dict[str, float]:
     from tpu_compressed_dp.utils.meters import GuardMeter, per_chip_comm_bytes
 
     guard_meter = GuardMeter()
-    from tpu_compressed_dp.harness.loop import (make_event_stream,
+    from tpu_compressed_dp.harness.loop import (job_scoped,
+                                                make_event_stream,
                                                 make_heartbeat,
                                                 make_preemption,
-                                                preempt_exit, profile_trace)
+                                                preempt_exit, profile_trace,
+                                                prom_labels)
     from tpu_compressed_dp.obs.export import (telemetry_snapshot,
                                               write_prometheus)
     from tpu_compressed_dp.obs.trace import StepTimeline
@@ -551,7 +553,8 @@ def run(args) -> Dict[str, float]:
                              **timeline.snapshot(),
                              **(ckpt.metrics() if ckpt is not None else {}),
                              **(el.metrics() if el is not None else {})},
-                            args.prom, labels={"harness": "lm"})
+                            job_scoped(args, args.prom),
+                            labels=prom_labels(args, harness="lm"))
                     table.append(summary)
                     # the log window's device_get drain + export work is not the
                     # next step's input-pipeline wait

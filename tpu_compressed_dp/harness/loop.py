@@ -9,6 +9,7 @@ globally-reduced metrics.
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Dict, Iterable, Optional, Tuple
 
 import jax
@@ -22,7 +23,8 @@ from tpu_compressed_dp.utils.timer import Timer
 __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
            "comm_summary", "guard_summary", "control_summary",
            "add_robustness_args", "add_adaptive_args",
-           "add_telemetry_args", "add_checkpoint_args", "build_robustness",
+           "add_telemetry_args", "job_scoped", "prom_labels",
+           "add_checkpoint_args", "build_robustness",
            "build_control", "build_elastic", "elastic_distributed_init",
            "make_heartbeat", "make_event_stream", "make_preemption",
            "preempt_exit", "profile_trace"]
@@ -49,7 +51,8 @@ def profile_trace(trace_dir: Optional[str]):
 
 
 def add_telemetry_args(p) -> None:
-    """The shared ``--events`` / ``--prom`` CLI surface (obs/export.py)."""
+    """The shared ``--events`` / ``--prom`` / ``--job_id`` CLI surface
+    (obs/export.py)."""
     p.add_argument("--events", type=str, default=None,
                    help="JSONL telemetry event stream path (schema-versioned;"
                         " one record per step/epoch/guard event — feed to "
@@ -57,18 +60,46 @@ def add_telemetry_args(p) -> None:
     p.add_argument("--prom", type=str, default=None,
                    help="Prometheus textfile path, rewritten atomically at "
                         "each epoch/log window with the latest metrics")
+    p.add_argument("--job_id", type=str,
+                   default=os.environ.get("TCDP_JOB_ID") or None,
+                   help="fleet job id (default: $TCDP_JOB_ID, exported by "
+                        "tools/fleet.py): prefixes the --events/--prom/"
+                        "--heartbeat file names (obs.export.job_scoped_path) "
+                        "and labels the Prometheus exposition job=\"<id>\", "
+                        "so jobs sharing one collector dir never clobber "
+                        "each other")
+
+
+def job_scoped(args, path):
+    """Apply the ``--job_id`` namespace to one telemetry path (no-op for
+    single-job runs)."""
+    from tpu_compressed_dp.obs.export import job_scoped_path
+
+    return job_scoped_path(path, getattr(args, "job_id", None))
+
+
+def prom_labels(args, **labels) -> Dict[str, str]:
+    """The harness's Prometheus label set: the caller's labels plus
+    ``job="<id>"`` under a fleet job id."""
+    job = getattr(args, "job_id", None)
+    if job:
+        labels["job"] = job
+    return labels
 
 
 def make_event_stream(args, **meta):
     """The harnesses' ``--events`` setup: a started
     :class:`~tpu_compressed_dp.obs.export.EventStream` on the master rank
     (metrics are globally reduced, every rank would write identical
-    records), or None."""
+    records), or None.  The path and metadata are job-scoped under
+    ``--job_id``."""
     if not getattr(args, "events", None) or jax.process_index() != 0:
         return None
     from tpu_compressed_dp.obs.export import EventStream
 
-    return EventStream(args.events, meta=dict(meta))
+    if getattr(args, "job_id", None):
+        meta = dict(meta, job=args.job_id)
+    return EventStream(job_scoped(args, args.events), meta=dict(meta))
 
 
 def add_robustness_args(p, *, check_note: str) -> None:
@@ -197,13 +228,19 @@ def control_summary(controller, control) -> Dict[str, float]:
 
 
 def make_heartbeat(args):
-    """The harnesses' ``--heartbeat`` setup: a started Heartbeat, or None."""
+    """The harnesses' ``--heartbeat`` setup: a started Heartbeat, or None.
+    The path is job-scoped under ``--job_id`` (two pool-sharing jobs must
+    not clobber one liveness file) and the payload names the job so a
+    fleet poll can attribute the verdict."""
     if not args.heartbeat:
         return None
     from tpu_compressed_dp.utils.resilience import Heartbeat
 
-    return Heartbeat(args.heartbeat, interval_s=args.heartbeat_interval,
-                     payload={"rank": jax.process_index()})
+    payload = {"rank": jax.process_index()}
+    if getattr(args, "job_id", None):
+        payload["job"] = args.job_id
+    return Heartbeat(job_scoped(args, args.heartbeat),
+                     interval_s=args.heartbeat_interval, payload=payload)
 
 
 def add_checkpoint_args(p, *, cadence_help: str) -> None:

@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional
 from tpu_compressed_dp.obs import registry
 
 __all__ = ["SCHEMA_VERSION", "EventStream", "read_events",
-           "write_prometheus", "telemetry_snapshot"]
+           "write_prometheus", "telemetry_snapshot", "job_scoped_path"]
 
 #: Bump when a record's field meaning changes incompatibly; consumers
 #: (trace_report, watchdog, tests) check it before interpreting fields.
@@ -78,6 +78,24 @@ class EventStream:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def job_scoped_path(path: Optional[str], job_id: Optional[str]) -> Optional[str]:
+    """Namespace a telemetry file path per job: ``dir/file`` becomes
+    ``dir/<job_id>.file``.
+
+    Two jobs sharing one device pool typically also share one textfile
+    collector / heartbeat directory; without a per-job prefix the second
+    job's atomic ``os.replace`` silently clobbers the first's export.  The
+    prefix keeps the atomic-replace semantics (same directory, same
+    filesystem) and leaves the file's registry HELP/TYPE content
+    untouched — only the NAME is scoped; the job identity inside the
+    exposition rides a ``job="<id>"`` label instead.  No-op when either
+    argument is falsy, so single-job runs keep their exact paths."""
+    if not path or not job_id:
+        return path
+    d, base = os.path.split(path)
+    return os.path.join(d, f"{job_id}.{base}")
 
 
 def read_events(path: str) -> List[Dict[str, Any]]:

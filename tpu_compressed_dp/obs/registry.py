@@ -225,6 +225,36 @@ declare("control/budget_ms", TIMING, "ms", "mean", "host",
         "open window's mean per-update hideable-compute budget")
 
 
+# --- fleet control plane (fleet/scheduler.py; host-side — the scheduler
+#     process is the single writer, per-job values carry a job="<id>"
+#     label in the textfile exposition) ----------------------------------
+declare("fleet/world", GAUGE, "devices", "max", "host",
+        "devices currently assigned to this job (0 while waiting)")
+declare("fleet/priority", GAUGE, "priority", "max", "host",
+        "the job spec's admission/preemption priority")
+declare("fleet/applied_updates", COUNTER, "updates", "max", "host",
+        "the job's applied-update watermark as last reported by its "
+        "controller poll")
+declare("fleet/restarts", COUNTER, "restarts", "max", "host",
+        "crash restarts burned from the job's budget (preemptions and "
+        "evictions are free, like the watchdog's preempt accounting)")
+declare("fleet/jobs_running", GAUGE, "jobs", "max", "host",
+        "jobs currently holding devices")
+declare("fleet/jobs_waiting", GAUGE, "jobs", "max", "host",
+        "admitted jobs waiting for capacity (incl. evicted jobs queued "
+        "for resume)")
+declare("fleet/devices_free", GAUGE, "devices", "max", "host",
+        "unassigned devices in the pool")
+declare("fleet/evictions", COUNTER, "jobs", "max", "host",
+        "priority preemptions executed over the fleet's lifetime "
+        "(SIGTERM -> emergency save -> exit 75)")
+declare("fleet/shrinks", COUNTER, "jobs", "max", "host",
+        "elastic shrinks executed to fund higher-priority placements")
+declare("fleet/readmits", COUNTER, "jobs", "max", "host",
+        "growth actions readmitting freed capacity into shrunken jobs "
+        "through the elastic readmit barrier")
+
+
 def canonical(key: str) -> str:
     """Map a raw engine stat key to its canonical registry name.
 

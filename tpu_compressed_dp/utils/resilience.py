@@ -20,6 +20,11 @@ recovery is "relaunch by hand" (`train.py:49`).  Net-new here:
     checkpoint write, cut an emergency save, and exit with
     :data:`PREEMPT_EXIT` — the code ``tools/watchdog.py --relaunch``
     respawns immediately on (no backoff, no retry-budget burn).
+  * ``spawn_supervised`` — the supervisor-side child launch shared by the
+    watchdog and the fleet scheduler: composes the incarnation
+    (``TCDP_RESTART_COUNT``) and elastic-rejoin (``TCDP_ELASTIC_DIR``,
+    ``TCDP_RENDEZVOUS_*``) environment over the operator's own without
+    clobbering it.
 """
 
 from __future__ import annotations
@@ -27,13 +32,14 @@ from __future__ import annotations
 import json
 import os
 import signal
+import subprocess
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 __all__ = ["Heartbeat", "read_heartbeat", "is_stale", "check_heartbeat",
            "run_with_recovery", "Preempted", "PreemptionHandler",
-           "PREEMPT_EXIT"]
+           "PREEMPT_EXIT", "spawn_supervised"]
 
 #: exit code of a preempted-and-checkpointed harness (EX_TEMPFAIL: "try
 #: again") — distinct from both clean exit (0) and crash (1), so the
@@ -197,6 +203,51 @@ class Heartbeat:
         self._stop.set()
         self._thread.join(timeout=self.interval_s + 1)
         self._write()
+
+
+def spawn_supervised(cmd: Sequence[str], *,
+                     restart_count: int,
+                     elastic_dir: Optional[str] = None,
+                     env: Optional[Dict[str, str]] = None,
+                     extra_env: Optional[Dict[str, str]] = None,
+                     popen: Callable[..., "subprocess.Popen"] = subprocess.Popen,
+                     log: Callable[[str], None] = print):
+    """Launch one supervised child with the incarnation/rejoin environment
+    — the spawn path shared by ``tools/watchdog.py --relaunch`` and the
+    fleet's subprocess controller (``tools/fleet.py``).
+
+    The child environment is a COPY of ``env`` (default ``os.environ``)
+    with only the supervision keys layered on top — an operator-set
+    variable is never clobbered unless the supervisor owns it:
+
+    * ``TCDP_RESTART_COUNT`` — supervisor-owned, always written: the
+      child Heartbeat's incarnation must be strictly larger each respawn.
+    * ``TCDP_ELASTIC_DIR`` + (when the rendezvous directory holds a
+      committed world epoch) ``TCDP_RENDEZVOUS_EPOCH``/``..._ADDR`` —
+      only with ``elastic_dir``: the rejoin hint that lands a restarted
+      host in the RUNNING world's join barrier
+      (``train/rendezvous.maybe_rejoin_from_env``) instead of forming a
+      fresh one.  Without a committed epoch the rendezvous keys are left
+      exactly as the operator set them.
+    * ``extra_env`` — caller-owned additions (the fleet's ``TCDP_JOB_ID``
+      and world/device assignment); applied last, so they win.
+
+    ``popen`` is injectable so unit tests capture the composed
+    environment without forking (tests/test_fleet.py)."""
+    child_env = dict(os.environ if env is None else env)
+    child_env["TCDP_RESTART_COUNT"] = str(int(restart_count))
+    if elastic_dir:
+        from tpu_compressed_dp.train.rendezvous import (DIR_ENV, export_env,
+                                                        read_epoch)
+        child_env[DIR_ENV] = elastic_dir
+        rec = read_epoch(elastic_dir)
+        if rec is not None:
+            export_env(child_env, rec)
+            log(f"spawn: rejoin hint: world epoch {rec['epoch']} "
+                f"@ {rec.get('address')}")
+    if extra_env:
+        child_env.update({str(k): str(v) for k, v in extra_env.items()})
+    return popen(list(cmd), env=child_env)
 
 
 def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
