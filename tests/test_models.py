@@ -19,7 +19,9 @@ def n_params(tree):
         (resnet9.ResNet9(), 32, 10),
         (resnet9.AlexNetGraph(), 32, 10),
         (alexnet.AlexNet(), 32, 10),
-        (vgg.vgg16(), 32, 10),
+        pytest.param(vgg.vgg16(), 32, 10, marks=pytest.mark.slow),
+        # vgg16 forward is ~25 s of conv compile on the 1-core CPU host;
+        # its construction/param-count contract stays tier-1 below
     ],
     ids=["resnet9", "alexnet_graph", "alexnet_module", "vgg16"],
 )

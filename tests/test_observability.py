@@ -113,14 +113,15 @@ class TestRegistryConformance:
         seen = set()
         for m, mode, transport, gran in itertools.product(
                 CONFORMANCE_METHODS, ("simulate", "wire"),
-                ("allgather", "sharded"),
+                ("allgather", "sharded", "hierarchical"),
                 ("layerwise", "entiremodel", "bucketed")):
             # EF composes with everything except the unbiased quantizers
             # (wire mode rejects that combination at build time)
             ef = m not in (None, "terngrad", "qsgd")
             cfg = CompressionConfig(
                 method=m, granularity=gran, mode=mode, transport=transport,
-                ratio=0.25, error_feedback=ef, check_sync=True)
+                ratio=0.25, error_feedback=ef, check_sync=True,
+                dp_pods=2 if transport == "hierarchical" else 1)
             keys = _sync_stat_keys(cfg, mesh)
             seen |= keys
             bad = obs_registry.undeclared(keys)
@@ -130,8 +131,10 @@ class TestRegistryConformance:
         # the matrix actually exercised the interesting keys (a silently
         # empty sweep would vacuously pass)
         for expected in ("sent_bits_psum", "sent_bits_alltoall",
-                         "shard_overflow", "threshold_overflow",
-                         "sync_agree", "guard/nonfinite"):
+                         "sent_bits_ici", "sent_bits_dcn",
+                         "sent_bits_dcn_route", "shard_overflow",
+                         "threshold_overflow", "sync_agree",
+                         "guard/nonfinite"):
             assert expected in seen, f"matrix never emitted {expected}"
 
     def test_step_metric_keys_declared(self):

@@ -48,7 +48,10 @@ def decision_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 
 
 def window_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
-    """One row per epoch/step window that carries control metrics."""
+    """One row per epoch/step window that carries control metrics.  When
+    the run billed per-fabric (``--transport hierarchical``/``--dp_pods``),
+    each row also carries the DCN-billed share of the wire bits — the
+    series the controller's modeled signal prices on a 2-level topology."""
     rows = []
     for e in events:
         if e.get("kind") not in WINDOW_KINDS:
@@ -56,7 +59,8 @@ def window_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         c = e.get("control") or {}
         if not c:
             continue
-        rows.append({
+        comm = e.get("comm") or {}
+        row = {
             "window": e.get("epoch", e.get("step", "?")),
             "kind": e["kind"],
             "rung": c.get("control/rung"),
@@ -64,7 +68,11 @@ def window_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "decisions": c.get("control/decisions"),
             "comm_ms": c.get("control/comm_ms"),
             "budget_ms": c.get("control/budget_ms"),
-        })
+        }
+        if comm.get("comm/sent_bits_dcn") or comm.get("comm/sent_bits_ici"):
+            row["dcn_bits"] = comm.get("comm/sent_bits_dcn", 0.0)
+            row["ici_bits"] = comm.get("comm/sent_bits_ici", 0.0)
+        rows.append(row)
     return rows
 
 
@@ -123,16 +131,21 @@ def render_report(events: List[Dict[str, Any]]) -> str:
 
     wins = window_rows(events)
     if wins:
+        fabric = any("dcn_bits" in r for r in wins)
         lines.append("")
         lines.append("per-window balance (epoch/step records):")
         lines.append(f"  {'window':>8}{'rung':>6}{'value':>9}"
-                     f"{'comm ms':>9}{'budget ms':>10}{'decisions':>11}")
+                     f"{'comm ms':>9}{'budget ms':>10}{'decisions':>11}"
+                     + (f"{'dcn b/upd':>11}{'ici b/upd':>11}" if fabric
+                        else ""))
         for r in wins:
             lines.append(
                 f"  {r['window']:>8}{_fmt(r['rung'], '6.0f')}"
                 f"{_fmt(r['value'], '9.4g')}{_fmt(r['comm_ms'])}"
                 f"{_fmt(r['budget_ms'], '10.2f')}"
-                f"{_fmt(r['decisions'], '11.0f')}")
+                f"{_fmt(r['decisions'], '11.0f')}"
+                + (f"{_fmt(r.get('dcn_bits'), '11.3g')}"
+                   f"{_fmt(r.get('ici_bits'), '11.3g')}" if fabric else ""))
 
     s = summarize(decs)
     lines.append("")

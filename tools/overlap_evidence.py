@@ -70,7 +70,8 @@ CHUNK_RE = re.compile(r"tcdp\.chunk(\d+)")
 
 def build_step(granularity: str, method, mesh, mode: str = "simulate",
                overlap: int = 1, error_feedback: Optional[bool] = None,
-               bucket_mb: float = 25.0, transport: str = "allgather"):
+               bucket_mb: float = 25.0, transport: str = "allgather",
+               dp_pods: int = 1):
     from tpu_compressed_dp.models.common import make_apply_fn
     from tpu_compressed_dp.bench.sweep import _build_model
     from tpu_compressed_dp.parallel.dp import CompressionConfig, init_ef_state
@@ -84,7 +85,8 @@ def build_step(granularity: str, method, mesh, mode: str = "simulate",
         method=method, granularity=granularity, mode=mode, ratio=0.01,
         error_feedback=(method is not None if error_feedback is None
                         else error_feedback),
-        sync_overlap=overlap, bucket_mb=bucket_mb, transport=transport)
+        sync_overlap=overlap, bucket_mb=bucket_mb, transport=transport,
+        dp_pods=dp_pods)
     opt = SGD(lr=0.01, momentum=0.9, weight_decay=5e-4)
 
     def make_state(seed):
@@ -163,19 +165,23 @@ def schedule_stats(txt: str):
                                 or "reduce-scatter(" in s
                                 or "all-to-all(" in s
                                 or "-start(" in s):
-            # operand count: top-level commas inside the call parens
-            call = s[s.index("(", s.index(m.group(1))):]
-            depth = 0
+            # operand count: top-level commas inside the call parens (a
+            # matched name with no following call paren — e.g. an async
+            # done/update line naming its start op — counts as 1 operand)
+            name_at = s.find(m.group(1))
+            paren_at = s.find("(", name_at) if name_at >= 0 else -1
             ops = 1
-            for ch in call:
-                if ch == "(":
-                    depth += 1
-                elif ch == ")":
-                    depth -= 1
-                    if depth == 0:
-                        break
-                elif ch == "," and depth == 1:
-                    ops += 1
+            if paren_at >= 0:
+                depth = 0
+                for ch in s[paren_at:]:
+                    if ch == "(":
+                        depth += 1
+                    elif ch == ")":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    elif ch == "," and depth == 1:
+                        ops += 1
             # payload bytes: sum the shapes of the RESULT tuple (everything
             # left of the call itself)
             call_at = s.find(" " + m.group(1) + (
@@ -249,6 +255,14 @@ DEFAULT_CASES = [
      "wire", "sharded"),
     ("topk1%-EF-wire-sharded-bucketed4MB-overlap4", "topk", "bucketed", 4,
      4.0, "wire", "sharded"),
+    # The hierarchical transport's ICI/DCN/ICI ladder composes with the
+    # chunk pipeline unchanged (chunk boundaries wrap whole groups, so
+    # each chunk runs its own two-level reduce under its tcdp.chunk
+    # scope); the trailing 2 is dp_pods on the 2x4 virtual mesh.
+    ("topk1%-EF-wire-hier2x4-bucketed4MB", "topk", "bucketed", 1, 4.0,
+     "wire", "hierarchical", 2),
+    ("topk1%-EF-wire-hier2x4-bucketed4MB-overlap4", "topk", "bucketed", 4,
+     4.0, "wire", "hierarchical", 2),
 ]
 
 
@@ -297,11 +311,14 @@ def main(argv=None):
         f"# chunk: the tcdp.chunk<ii> overlap scope that issued the",
         f"# collective (sync_overlap=K rows; '-' = unchunked).", ""]
     summaries = {}
-    for label, method, gran, overlap, bucket_mb, mode, transport in cases:
+    for case in cases:
+        label, method, gran, overlap, bucket_mb, mode, transport = case[:7]
+        dp_pods = case[7] if len(case) > 7 else 1
         step, state_s, batch_s = build_step(gran, method, mesh, mode=mode,
                                             overlap=overlap,
                                             bucket_mb=bucket_mb,
-                                            transport=transport)
+                                            transport=transport,
+                                            dp_pods=dp_pods)
         # make_train_step returns a python wrapper around its internal jit;
         # an outer jit inlines it and exposes .lower for AOT
         txt = compile_text(jax.jit(step).lower(state_s, batch_s))

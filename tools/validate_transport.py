@@ -48,6 +48,13 @@ CASES = [
     # for the third collective
     ("topk-1%-wire-EF-sharded", "topk", "wire",
      {"ratio": 0.01, "error_feedback": True, "transport": "sharded"}),
+    # hierarchical transport on a 2x1 virtual mesh: each process is its own
+    # pod (C=1, no intra-pod psum), so the measured loopback bytes are
+    # EXACTLY the inter-pod route/return collectives the sent_bits_dcn
+    # bucket bills — the per-fabric split's measured-vs-analytic closure
+    ("topk-1%-wire-EF-hier", "topk", "wire",
+     {"ratio": 0.01, "error_feedback": True, "transport": "hierarchical",
+      "dp_pods": 2}),
     ("terngrad-wire", "terngrad", "wire", {}),
 ]
 
@@ -80,6 +87,7 @@ def worker(args) -> None:
         ratio=extra.get("ratio", 0.01),
         block_size=extra.get("block_size", 256),
         transport=extra.get("transport", "allgather"),
+        dp_pods=extra.get("dp_pods", 1),
         error_feedback=extra.get("error_feedback", False))
     sync = make_grad_sync(cfg, "data")
     mesh = Mesh(np.array(jax.devices()), ("data",))
@@ -143,6 +151,10 @@ def worker(args) -> None:
             "sent_bits_psum": float(stats.get("sent_bits_psum", 0.0)),
             "sent_bits_allgather": float(stats.get("sent_bits_allgather", 0.0)),
             "sent_bits_alltoall": float(stats.get("sent_bits_alltoall", 0.0)),
+            "sent_bits_ici": float(stats.get("sent_bits_ici", 0.0)),
+            "sent_bits_dcn": float(stats.get("sent_bits_dcn", 0.0)),
+            "sent_bits_dcn_route": float(
+                stats.get("sent_bits_dcn_route", 0.0)),
         }
         print("RESULT " + json.dumps(rec), flush=True)
 
@@ -161,7 +173,7 @@ def main(argv=None):
     if args.worker:
         return worker(args)
 
-    from tpu_compressed_dp.utils.meters import per_chip_traffic_bytes
+    from tpu_compressed_dp.utils.meters import per_fabric_traffic_bytes
 
     rows = []
     for ci, (label, method, mode, extra) in enumerate(CASES):
@@ -202,9 +214,17 @@ def main(argv=None):
         psum_b = rec["sent_bits_psum"] / 8.0
         ag_b = rec["sent_bits_allgather"] / 8.0
         a2a_b = rec.get("sent_bits_alltoall", 0.0) / 8.0
-        if psum_b == 0.0 and ag_b == 0.0 and a2a_b == 0.0:
+        ici_b = rec.get("sent_bits_ici", 0.0) / 8.0
+        dcn_b = rec.get("sent_bits_dcn", 0.0) / 8.0
+        rt_b = rec.get("sent_bits_dcn_route", 0.0) / 8.0
+        if psum_b == ag_b == a2a_b == 0.0 and ici_b + dcn_b == 0.0:
             psum_b = rec["sent_bits"] / 8.0
-        per_rank = per_chip_traffic_bytes(psum_b, ag_b, w, a2a_b)
+        pods = extra.get("dp_pods", 1)
+        # per_fabric degenerates to the flat per_chip arithmetic at pods=1;
+        # at pods>1 the hier group collectives bill with their own factors
+        per_rank = sum(per_fabric_traffic_bytes(
+            psum_b, ag_b, w, a2a_b, ici_b, rt_b, max(dcn_b - rt_b, 0.0),
+            pods))
         analytic = per_rank * w
         measured = rec["lo_tx_per_step"]
         rows.append({

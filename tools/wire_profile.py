@@ -262,9 +262,92 @@ def _sharded_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
     return chain
 
 
+def _hier_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
+    """Stage ladder for the HIERARCHICAL transport (transport=
+    'hierarchical'): mag -> threshold -> pack (select + scatter the dense
+    contribution) -> ici_reduce (intra-pod dense psum) -> recompress (pod
+    union pack + per-chip slab slice) -> dcn_route (the grouped owner-
+    sharded exchange across pods) -> return (the second intra-pod psum
+    summing disjoint slab partials) -> ef.  Mirrors ops/wire._hier_combine
+    — update both together.  Run with --devices >= dp_pods*2 (forced host
+    devices) so the grouped collectives exist; on fewer devices than pods
+    the plan constructor raises."""
+    from tpu_compressed_dp.ops import wire_sharded
+
+    def chain(flat: jax.Array):
+        mag = jnp.abs(flat).astype(jnp.float32)
+        out = jnp.sum(mag[:8])
+        if upto == "mag":
+            return out
+        t = kernels.topk_threshold(mag, keep)
+        out = out + t
+        if upto == "threshold":
+            return out
+        idx = wire.packed_indices_from_mask(mag >= t, keep)
+        vals = wire._sorted_gather(flat, idx)
+        contrib = jnp.zeros((n,), flat.dtype).at[idx].set(
+            vals, indices_are_sorted=True, unique_indices=True,
+            mode="promise_in_bounds")
+        out = out + jnp.sum(contrib[:8])
+        if upto == "pack":
+            return out
+        world = jax.lax.psum(1, axis_name)
+        plan = wire_sharded.make_hier_plan(
+            n, keep, world, cfg.dp_pods, cfg.hier_route_factor_ici,
+            cfg.hier_route_factor_dcn)
+        pods, chips = plan.pods, plan.chips
+        ici_groups, dcn_groups = wire_sharded.hier_axis_groups(world, pods)
+        pod_sum = (jax.lax.psum(contrib, axis_name,
+                                axis_index_groups=ici_groups)
+                   if chips > 1 else contrib)
+        out = out + jnp.sum(pod_sum[:8])
+        if upto == "ici_reduce":
+            return out
+        cap = plan.cap_union
+        mask = pod_sum != 0
+        nnz = jnp.sum(mask, dtype=jnp.int32)
+        uidx = wire.packed_indices_from_mask(mask, cap)
+        uvalid = (jnp.arange(1, cap + 1, dtype=jnp.int32)
+                  <= jnp.minimum(nnz, cap))
+        uvals = jnp.where(
+            uvalid, pod_sum.at[uidx].get(mode="promise_in_bounds"), 0.0)
+        uidx = jnp.where(uvalid, uidx, 0)
+        c_rank = jax.lax.axis_index(axis_name) % chips
+        s_vals = jax.lax.dynamic_slice_in_dim(
+            uvals, c_rank * plan.slab, plan.slab)
+        s_idx = jax.lax.dynamic_slice_in_dim(
+            uidx, c_rank * plan.slab, plan.slab)
+        s_valid = jax.lax.dynamic_slice_in_dim(
+            uvalid, c_rank * plan.slab, plan.slab)
+        out = out + jnp.sum(s_vals[:8])
+        if upto == "recompress":
+            return out
+        dense_u, _, _, _, _ = wire_sharded.sharded_combine(
+            s_vals, s_idx, plan.dcn, axis_name, valid=s_valid,
+            axis_index_groups=dcn_groups)
+        partial = dense_u[:n]
+        out = out + jnp.sum(partial[:8])
+        if upto == "dcn_route":
+            return out
+        total = (jax.lax.psum(partial, axis_name,
+                              axis_index_groups=ici_groups)
+                 if chips > 1 else partial)
+        out = out + jnp.sum(total[:8]) / world
+        if upto == "return":
+            return out
+        new_ef = flat.at[idx].set(0, indices_are_sorted=True,
+                                  unique_indices=True,
+                                  mode="promise_in_bounds")
+        return out + jnp.sum(new_ef[:8])
+
+    return chain
+
+
 STAGES = ["mag", "threshold", "pack", "gather", "combine", "ef"]
 SHARDED_STAGES = ["mag", "threshold", "pack", "gather", "route", "reduce",
                   "return", "ef"]
+HIER_STAGES = ["mag", "threshold", "pack", "ici_reduce", "recompress",
+               "dcn_route", "return", "ef"]
 
 
 def time_fn(fn, x, iters: int, warmup_s: float = 3.0):
@@ -291,14 +374,20 @@ def main(argv=None):
     ap.add_argument("--pack2", action="store_true",
                     help="run the (negative-result) full-scatter formulation")
     ap.add_argument("--transport", default="allgather",
-                    choices=["allgather", "sharded"],
-                    help="profile the flat all_gather combine or the "
-                         "owner-sharded route/reduce/return chain")
+                    choices=["allgather", "sharded", "hierarchical"],
+                    help="profile the flat all_gather combine, the "
+                         "owner-sharded route/reduce/return chain, or the "
+                         "two-level ici-reduce/recompress/dcn-route ladder")
     ap.add_argument("--devices", type=int, default=1,
                     help="mesh size for the ladder (sharded bucket geometry "
                          "scales with W; >1 needs forced host devices)")
     ap.add_argument("--shard_route_factor", type=float, default=1.25)
     ap.add_argument("--shard_return_factor", type=float, default=1.25)
+    ap.add_argument("--dp_pods", type=int, default=2,
+                    help="hierarchical: DCN axis of the dp_pods x dp_chips "
+                         "virtual mesh (must divide --devices)")
+    ap.add_argument("--hier_route_factor_ici", type=float, default=1.25)
+    ap.add_argument("--hier_route_factor_dcn", type=float, default=1.25)
     args = ap.parse_args(argv)
 
     n = args.n
@@ -316,6 +405,16 @@ def main(argv=None):
             shard_return_factor=args.shard_return_factor)
         stages = SHARDED_STAGES
         build = lambda st: _sharded_chain(st, n, keep, cfg)
+    elif args.transport == "hierarchical":
+        from tpu_compressed_dp.parallel.dp import CompressionConfig
+
+        cfg = CompressionConfig(
+            method="topk", mode="wire", transport="hierarchical",
+            ratio=args.ratio, dp_pods=args.dp_pods,
+            hier_route_factor_ici=args.hier_route_factor_ici,
+            hier_route_factor_dcn=args.hier_route_factor_dcn)
+        stages = HIER_STAGES
+        build = lambda st: _hier_chain(st, n, keep, cfg)
     else:
         stages = STAGES
         build = lambda st: _stage_chain(st, n, keep)

@@ -424,6 +424,11 @@ def _engine_configs(profile: str):
 
     def mk(m, mode, transport, gran, **kw):
         ef = kw.pop("error_feedback", m not in (None, "terngrad", "qsgd"))
+        if transport == "hierarchical":
+            # 2x2 virtual mesh on the lint pass's 4-device data axis —
+            # exercises both the grouped ICI psums and the grouped DCN
+            # route/return collectives
+            kw.setdefault("dp_pods", 2)
         return CompressionConfig(method=m, granularity=gran, mode=mode,
                                  transport=transport, ratio=0.25,
                                  error_feedback=ef, check_sync=True, **kw)
@@ -431,12 +436,15 @@ def _engine_configs(profile: str):
     if profile == "full":
         return [mk(m, mode, tr, gran) for m, mode, tr, gran in
                 itertools.product(ENGINE_METHODS, ("simulate", "wire"),
-                                  ("allgather", "sharded"),
+                                  ("allgather", "sharded", "hierarchical"),
                                   ("layerwise", "entiremodel", "bucketed"))]
     # quick: each method once on the wire path, plus transport/granularity
     # variants for the index-carrying representative
     cfgs = [mk(m, "wire", "allgather", "bucketed") for m in ENGINE_METHODS]
     cfgs += [mk("topk", "wire", "sharded", "bucketed"),
+             mk("topk", "wire", "hierarchical", "bucketed"),
+             mk("thresholdv", "wire", "hierarchical", "entiremodel"),
+             mk("topk", "simulate", "hierarchical", "bucketed"),
              mk("topk", "wire", "allgather", "layerwise"),
              mk("topk", "wire", "allgather", "entiremodel"),
              mk("topk", "simulate", "allgather", "bucketed")]

@@ -86,6 +86,9 @@ def run_point(
     wire_cap_ratio: float = 0.05,
     shard_route_factor: float = 1.25,
     shard_return_factor: float = 1.25,
+    dp_pods: int = 1,
+    hier_route_factor_ici: float = 1.25,
+    hier_route_factor_dcn: float = 1.25,
     rank: int = 4,
     error_feedback: bool = False,
     sync_overlap: int = 1,
@@ -120,7 +123,10 @@ def run_point(
         qstates=qstates, block_size=block_size, bucket_mb=bucket_mb,
         wire_cap_ratio=wire_cap_ratio,
         shard_route_factor=shard_route_factor,
-        shard_return_factor=shard_return_factor, rank=rank,
+        shard_return_factor=shard_return_factor,
+        dp_pods=dp_pods,
+        hier_route_factor_ici=hier_route_factor_ici,
+        hier_route_factor_dcn=hier_route_factor_dcn, rank=rank,
         error_feedback=error_feedback, sync_overlap=sync_overlap,
     )
     state = TrainState.create(
@@ -198,32 +204,48 @@ def run_point(
         # everything at the ring factor understated all_gather methods by
         # ~W/2 — the class of error the reference avoided by measuring real
         # NIC bytes (`meter.py:24-47`).
-        from tpu_compressed_dp.utils.meters import per_chip_traffic_bytes
+        from tpu_compressed_dp.utils.meters import (per_chip_traffic_bytes,
+                                                    per_fabric_traffic_bytes)
 
         psum_mb = float(metrics.get("comm/sent_bits_psum", 0.0)) / 8 / 1e6
         ag_mb = float(metrics.get("comm/sent_bits_allgather", 0.0)) / 8 / 1e6
         a2a_mb = float(metrics.get("comm/sent_bits_alltoall", 0.0)) / 8 / 1e6
-        # the collective(s) the wire form rides: a2a > 0 marks the sharded
+        ici_mb = float(metrics.get("comm/sent_bits_ici", 0.0)) / 8 / 1e6
+        dcn_mb = float(metrics.get("comm/sent_bits_dcn", 0.0)) / 8 / 1e6
+        rt_mb = float(metrics.get("comm/sent_bits_dcn_route", 0.0)) / 8 / 1e6
+        # the collective(s) the wire form rides: hier group bits mark the
+        # two-level transport (any flat bucket alongside, e.g. keep-all
+        # dense-fallback groups, is 'mixed'); a2a > 0 marks the sharded
         # route stage (its shard return bills as allgather); any psum
-        # alongside it (e.g. keep-all dense-fallback groups) is 'mixed',
-        # matching the pre-sharded classifier's semantics
-        transport_rode = (("sharded" if psum_mb == 0.0 else "mixed")
+        # alongside it is likewise 'mixed', matching the pre-sharded
+        # classifier's semantics
+        flat_mb = psum_mb + ag_mb + a2a_mb
+        transport_rode = (("hierarchical" if flat_mb == 0.0 else "mixed")
+                          if ici_mb + dcn_mb > 0.0
+                          else ("sharded" if psum_mb == 0.0 else "mixed")
                           if a2a_mb > 0.0
                           else "psum" if ag_mb == 0.0
                           else "all_gather" if psum_mb == 0.0 else "mixed")
 
+        def fabric_mb(w: int) -> tuple:
+            return per_fabric_traffic_bytes(
+                psum_mb, ag_mb, w, a2a_mb, ici_mb, rt_mb,
+                max(dcn_mb - rt_mb, 0.0), dp_pods)
+
         def gbps_per_chip(w: int) -> tuple:
-            comp_gbps = (per_chip_traffic_bytes(psum_mb, ag_mb, w, a2a_mb)
-                         / 1e3 * (steps / dt))
+            comp_gbps = sum(fabric_mb(w)) / 1e3 * (steps / dt)
             dense_gbps = per_chip_traffic_bytes(dense_mb, 0.0, w) / 1e3 * (steps / dt)
             return comp_gbps, dense_gbps
 
         comp_gbps, dense_gbps = gbps_per_chip(ndev)
+        traffic_ici, traffic_dcn = fabric_mb(ndev)
         record.update({
             "payload_mb_per_step": round(payload_mb, 4),
             "payload_mb_psum": round(psum_mb, 4),
             "payload_mb_allgather": round(ag_mb, 4),
             "payload_mb_alltoall": round(a2a_mb, 4),
+            "payload_mb_ici": round(ici_mb, 4),
+            "payload_mb_dcn": round(dcn_mb, 4),
             "dense_mb_per_step": round(dense_mb, 4),
             "transport": transport_rode,
             "sent_frac": round(float(metrics["comm/sent_elems"])
@@ -234,11 +256,15 @@ def run_point(
             "dense_allreduce_gbps_per_chip": round(dense_gbps, 3),
             # per-step per-chip link traffic at the RUN's device count —
             # the rate-free quantity transport comparisons (allgather vs
-            # sharded, BENCH_r07) are made on
-            "per_chip_traffic_mb": round(
-                per_chip_traffic_bytes(psum_mb, ag_mb, ndev, a2a_mb), 4),
+            # sharded, BENCH_r07; per-fabric split for hierarchical,
+            # BENCH_r10) are made on
+            "per_chip_traffic_mb": round(traffic_ici + traffic_dcn, 4),
+            "per_chip_traffic_mb_ici": round(traffic_ici, 4),
+            "per_chip_traffic_mb_dcn": round(traffic_dcn, 4),
             "num_collectives": float(metrics["comm/num_collectives"]),
         })
+        if dp_pods > 1:
+            record["dp_pods"] = dp_pods
         if "comm/shard_overflow" in metrics:
             record["shard_overflow"] = float(metrics["comm/shard_overflow"])
         # Analytic multi-chip projection (VERDICT r1 weak #6): single-chip
@@ -517,6 +543,9 @@ def run_sweep(args) -> List[Dict[str, float]]:
         wire_cap_ratio=args.wire_cap_ratio,
         shard_route_factor=args.shard_route_factor,
         shard_return_factor=args.shard_return_factor,
+        dp_pods=args.dp_pods,
+        hier_route_factor_ici=args.hier_route_factor_ici,
+        hier_route_factor_dcn=args.hier_route_factor_dcn,
         mode=args.mode, threshold=args.threshold, qstates=args.qstates,
         block_size=args.block_size,
         bucket_mb=args.bucket_mb,
@@ -595,10 +624,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="r values for powersgd (its sweep axis instead of k)")
     p.add_argument("--granularities", default="layerwise,entiremodel")
     p.add_argument("--transports", default="allgather",
-                   help="comma list of allgather,sharded — the index-carrying"
-                        " sparsifiers run once per transport (sharded = the"
-                        " owner-sharded sparse reduce, O(k + n/W) per chip vs"
-                        " allgather's O(W*k); other methods are unaffected)")
+                   help="comma list of allgather,sharded,hierarchical — the"
+                        " index-carrying sparsifiers run once per transport"
+                        " (sharded = the owner-sharded sparse reduce, O(k +"
+                        " n/W) per chip vs allgather's O(W*k); hierarchical ="
+                        " the two-level dense-ICI + sparse-DCN reduce over a"
+                        " dp_pods x dp_chips virtual mesh, O(k + n/W_pods)"
+                        " billed DCN bytes; other methods are unaffected)")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--threshold", type=float, default=1e-3,
                    help="V for thresholdv")
@@ -631,6 +663,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shard_return_factor", type=float, default=1.25,
                    help="sharded transport return-union buffer capacity, "
                         "in units of k/W")
+    p.add_argument("--dp_pods", type=int, default=1,
+                   help="hierarchical transport: pod count P of the "
+                        "dp_pods x dp_chips virtual mesh (must divide the "
+                        "device count; 1 = flat)")
+    p.add_argument("--hier_route_factor_ici", type=float, default=1.25,
+                   help="hierarchical transport intra-pod union capacity, "
+                        "in units of k")
+    p.add_argument("--hier_route_factor_dcn", type=float, default=1.25,
+                   help="hierarchical transport inter-pod bucket capacity, "
+                        "in units of slab/P")
     p.add_argument("--tsv", type=str, default=None)
     p.add_argument("--adaptive", action="store_true",
                    help="closed-loop controller comparison instead of the "

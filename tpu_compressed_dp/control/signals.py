@@ -16,7 +16,8 @@ from typing import Optional, Sequence
 
 from tpu_compressed_dp.control.config import ControlConfig
 
-__all__ = ["WindowSignals", "modeled_comm_ms", "hideable_budget_ms"]
+__all__ = ["WindowSignals", "modeled_comm_ms", "hideable_budget_ms",
+           "billed_signal_bits"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +39,25 @@ def modeled_comm_ms(bits_per_update: float, bandwidth_mbps: float) -> float:
     replayed — crash, resume, chaos — models the identical comm time.
     """
     return float(bits_per_update) / (float(bandwidth_mbps) * 1e3)
+
+
+def billed_signal_bits(comm_means, pods: int = 1) -> float:
+    """The billed-bits series the modeled signal prices: on a flat mesh the
+    whole ``comm/sent_bits``; on a 2-level topology (``pods > 1``) the
+    DCN-billed share (``comm/sent_bits_dcn`` plus any flat whole-world
+    collectives, which span the slow fabric too) — the inter-pod link is
+    the binding constraint a cross-pod bandwidth budget is set against,
+    and pricing intra-pod ICI payloads at DCN bandwidth would drive the
+    controller to over-compress by orders of magnitude.
+
+    ``comm_means`` is a ``comm/*`` metrics dict of per-update means.
+    Deterministic: a pure function of the engines' analytic billed bits.
+    """
+    total = float(comm_means.get("comm/sent_bits", 0.0))
+    if pods <= 1:
+        return total
+    ici = float(comm_means.get("comm/sent_bits_ici", 0.0))
+    return total - ici
 
 
 def hideable_budget_ms(cfg: ControlConfig, *,

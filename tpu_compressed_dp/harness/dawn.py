@@ -34,6 +34,7 @@ from tpu_compressed_dp.harness.loop import (add_adaptive_args,
                                             add_checkpoint_args,
                                             add_robustness_args,
                                             add_telemetry_args,
+                                            add_topology_args,
                                             build_control,
                                             build_elastic, build_robustness,
                                             control_summary,
@@ -166,11 +167,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(bounds the EF residual spike; see tools/ef_bisect.py)")
     p.add_argument("--mode", type=str, default="simulate", choices=["simulate", "wire"])
     p.add_argument("--transport", default="allgather",
-                   choices=["allgather", "sharded"],
+                   choices=["allgather", "sharded", "hierarchical"],
                    help="wire combine for index-carrying sparsifiers: flat "
-                        "all_gather (O(W*k)/chip) or owner-sharded reduce "
+                        "all_gather (O(W*k)/chip), owner-sharded reduce "
                         "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
-                        "via comm/shard_overflow)")
+                        "via comm/shard_overflow), or the two-level "
+                        "hierarchical reduce over a --dp_pods x chips "
+                        "virtual mesh (O(k + n/W_pods) DCN bytes)")
+    add_topology_args(p)
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--overlap", type=int, default=1,
                    help="chunk-pipelined sync (parallel/overlap.py): split "
@@ -380,6 +384,9 @@ def run(args) -> dict:
             bucket_mb=args.bucket_mb,
             wire_cap_ratio=args.wire_cap_ratio,
             transport=args.transport,
+            dp_pods=args.dp_pods,
+            hier_route_factor_ici=args.hier_route_factor_ici,
+            hier_route_factor_dcn=args.hier_route_factor_dcn,
             rank=args.rank,
             error_feedback=args.error_feedback,
             sync_overlap=args.overlap,
@@ -539,6 +546,7 @@ def run(args) -> dict:
                         timer, cur_bs, test_time_in_total=False,
                         crash=crash, step_offset=int(state.step),
                         guard_cfg=guard_cfg, timeline=timeline, world=ndev,
+                        pods=args.dp_pods,
                         elastic=el, preempt=preempt,
                     )
             except Exception as err:

@@ -47,6 +47,8 @@ from tpu_compressed_dp.harness.loop import (
     add_adaptive_args,
     add_robustness_args,
     add_telemetry_args,
+    add_topology_args,
+    fabric_gauges,
     build_control,
     build_elastic,
     build_robustness,
@@ -259,11 +261,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bucketed granularity: capacity per bucket")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--transport", default="allgather",
-                   choices=["allgather", "sharded"],
+                   choices=["allgather", "sharded", "hierarchical"],
                    help="wire combine for index-carrying sparsifiers: flat "
-                        "all_gather (O(W*k)/chip) or owner-sharded reduce "
+                        "all_gather (O(W*k)/chip), owner-sharded reduce "
                         "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
-                        "via comm/shard_overflow)")
+                        "via comm/shard_overflow), or the two-level "
+                        "hierarchical reduce over a --dp_pods x chips "
+                        "virtual mesh (dense intra-pod psum + sparse "
+                        "inter-pod exchange, O(k + n/W_pods) DCN bytes)")
+    add_topology_args(p)
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--overlap", type=int, default=1,
                    help="chunk-pipelined sync (parallel/overlap.py): up to "
@@ -376,6 +382,9 @@ def run(args) -> Dict[str, float]:
         bucket_mb=args.bucket_mb,
         wire_cap_ratio=args.wire_cap_ratio,
         transport=args.transport,
+        dp_pods=args.dp_pods,
+        hier_route_factor_ici=args.hier_route_factor_ici,
+        hier_route_factor_dcn=args.hier_route_factor_dcn,
         rank=args.rank,
         error_feedback=args.error_feedback,
         sync_overlap=args.overlap,
@@ -518,6 +527,7 @@ def run(args) -> Dict[str, float]:
         return fwd_cache[key]
 
     prev_skipped = 0.0
+    fabric_g: dict = {}  # previous epoch's net/ per-fabric gauges
     # finally-guarded: GuardExceeded / ChaosCrash / any failure must not
     # leak the heartbeat writer thread (an orphaned writer keeps the ts
     # fresh and defeats staleness detection), the checkpoint manager, a
@@ -604,6 +614,9 @@ def run(args) -> Dict[str, float]:
                     **({"elastic": el.metrics()} if el is not None else {}),
                     **(controller.heartbeat_fields(state.control)
                        if controller is not None else {}),
+                    # last finished epoch's per-fabric billing: lets a
+                    # fleet poll see the DCN demand without scraping prom
+                    **({"net": fabric_g} if fabric_g else {}),
                 )
             train_time = timer()
             if controller is not None:
@@ -616,10 +629,17 @@ def run(args) -> Dict[str, float]:
                            if guard_cfg is not None else int(state.step))
                 wall_ms = train_time * 1e3 / max(acc.steps, 1)
                 old_rung = int(state.control.rung)
+                # on a 2-level topology the modeled signal prices only the
+                # DCN-billed share — the fabric --adaptive_bw_mbps budgets
+                from tpu_compressed_dp.control.signals import \
+                    billed_signal_bits
+
                 new_control, _ = controller.tick(
                     state.control, applied=applied,
                     signals=controller.window_signals(
-                        mean_bits=acc.mean("comm/sent_bits"),
+                        mean_bits=billed_signal_bits(
+                            {k: acc.mean(k) for k in acc.sums
+                             if k.startswith("comm/")}, args.dp_pods),
                         measured_comm_ms=wall_ms,
                         compute_ms=wall_ms,
                         hideable_fraction=hide_frac))
@@ -671,9 +691,14 @@ def run(args) -> Dict[str, float]:
             # with bench/sweep.py and the other harnesses
             from tpu_compressed_dp.utils.meters import per_chip_comm_bytes
 
-            per_chip_b = per_chip_comm_bytes(comm_means, ndev)
+            per_chip_b = per_chip_comm_bytes(comm_means, ndev, args.dp_pods)
             if per_chip_b is not None and train_time > 0:
                 summary["comm MB/s"] = per_chip_b * acc.steps / train_time / 1e6
+            # per-fabric net/ gauges (empty on a flat mesh): what the DCN
+            # specifically must sustain — the signal a cross-pod budget is
+            # set against (tools/control_report.py --bw columns)
+            fabric_g = fabric_gauges(comm_means, ndev, args.dp_pods,
+                                     acc.steps, train_time)
             table.append(summary)
             tsv.append(summary)
             if events is not None:
@@ -693,6 +718,7 @@ def run(args) -> Dict[str, float]:
             if args.prom and is_master:
                 write_prometheus(
                     {"loss": summary["train loss"], **thr, **comm_means,
+                     **fabric_g,
                      **guard_last, **control_stats, **timeline.snapshot(),
                      **(ckpt.metrics() if ckpt is not None else {}),
                      **(el.metrics() if el is not None else {})},
@@ -715,6 +741,8 @@ def run(args) -> Dict[str, float]:
                               acc.mean("comm/sent_bits") / 8 / 1e6)
                 tb.log_scalar("net/allreduce_gbps_per_chip",
                               per_chip_b * acc.steps / 1e9 / train_time)
+            for k, v in fabric_g.items():
+                tb.log_scalar(k, v)
             recv_g, sent_g = net_meter.update_bandwidth()
             tb.log_scalar("net/recv_gbit_s", recv_g)
             tb.log_scalar("net/transmit_gbit_s", sent_g)

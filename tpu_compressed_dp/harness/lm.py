@@ -102,11 +102,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "capacity (fraction of elements)")
     p.add_argument("--mode", default="simulate", choices=["simulate", "wire"])
     p.add_argument("--transport", default="allgather",
-                   choices=["allgather", "sharded"],
+                   choices=["allgather", "sharded", "hierarchical"],
                    help="wire combine for index-carrying sparsifiers: flat "
-                        "all_gather (O(W*k)/chip) or owner-sharded reduce "
+                        "all_gather (O(W*k)/chip), owner-sharded reduce "
                         "(O(k + n/W)/chip, ops/wire_sharded.py; size caps "
-                        "via comm/shard_overflow)")
+                        "via comm/shard_overflow), or the two-level "
+                        "hierarchical reduce over a --dp_pods x chips "
+                        "virtual mesh (O(k + n/W_pods) DCN bytes)")
     p.add_argument("--error_feedback", action="store_true")
     p.add_argument("--overlap", type=int, default=1,
                    help="chunk-pipelined sync (parallel/overlap.py): up to "
@@ -116,8 +118,10 @@ def build_parser() -> argparse.ArgumentParser:
     # robustness: shared --guard*/--chaos/--heartbeat surface
     from tpu_compressed_dp.harness.loop import (add_adaptive_args,
                                                 add_robustness_args,
-                                                add_telemetry_args)
+                                                add_telemetry_args,
+                                                add_topology_args)
 
+    add_topology_args(p)
     add_robustness_args(p, check_note="checked every --log_every")
     # adaptive compression: shared --adaptive* surface (control/); the LM
     # loop's decision cadence is the --log_every metric-fetch window
@@ -213,6 +217,9 @@ def run(args) -> Dict[str, float]:
         bucket_mb=args.bucket_mb,
         wire_cap_ratio=args.wire_cap_ratio,
         transport=args.transport,
+        dp_pods=args.dp_pods,
+        hier_route_factor_ici=args.hier_route_factor_ici,
+        hier_route_factor_dcn=args.hier_route_factor_dcn,
         rank=args.rank,
         error_feedback=args.error_feedback,
         sync_overlap=args.overlap,
@@ -488,7 +495,8 @@ def run(args) -> Dict[str, float]:
                             float(m["comm/dense_elems"]), 1.0)
                         summary["wire frac"] = float(m["comm/sent_bits"]) / (
                             32.0 * max(float(m["comm/dense_elems"]), 1.0))
-                        per_chip_b = per_chip_comm_bytes(comm_m, world)
+                        per_chip_b = per_chip_comm_bytes(comm_m, world,
+                                                         args.dp_pods)
                         if per_chip_b is not None and steps_timed > 0:
                             summary["comm MB/s"] = round(
                                 per_chip_b * (steps_timed / dt) / 1e6, 3)

@@ -22,7 +22,8 @@ from tpu_compressed_dp.utils.timer import Timer
 
 __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
            "comm_summary", "guard_summary", "control_summary",
-           "add_robustness_args", "add_adaptive_args",
+           "fabric_gauges",
+           "add_robustness_args", "add_adaptive_args", "add_topology_args",
            "add_telemetry_args", "job_scoped", "prom_labels",
            "add_checkpoint_args", "build_robustness",
            "build_control", "build_elastic", "elastic_distributed_init",
@@ -68,6 +69,46 @@ def add_telemetry_args(p) -> None:
                         "and labels the Prometheus exposition job=\"<id>\", "
                         "so jobs sharing one collector dir never clobber "
                         "each other")
+
+
+def add_topology_args(p) -> None:
+    """The shared ``--dp_pods`` / ``--hier_route_factor_*`` CLI surface for
+    ``--transport hierarchical`` (the dp_pods x dp_chips virtual mesh of
+    parallel/dp.py)."""
+    p.add_argument("--dp_pods", type=int, default=1,
+                   help="hierarchical transport: pod count P of the "
+                        "dp_pods x dp_chips virtual mesh (must divide the "
+                        "data axis; 1 = flat).  Also splits the billed "
+                        "comm arithmetic per fabric (net/dcn_* gauges)")
+    p.add_argument("--hier_route_factor_ici", type=float, default=1.25,
+                   help="hierarchical transport: intra-pod union capacity "
+                        "in units of k (clips fold into EF)")
+    p.add_argument("--hier_route_factor_dcn", type=float, default=1.25,
+                   help="hierarchical transport: inter-pod bucket capacity "
+                        "in units of slab/P (clips fold into EF)")
+
+
+def fabric_gauges(comm_means: Dict[str, float], world: int, pods: int,
+                  steps: int, seconds: float) -> Dict[str, float]:
+    """Per-fabric ``net/`` gauges (obs/registry.py) from an epoch's mean
+    ``comm/*`` metrics: DCN MB per step per chip, and the per-chip Gb/s
+    each fabric must sustain at the measured step rate.  Empty on a flat
+    mesh (``pods <= 1``) or when comm metrics are absent — the DCN split
+    only means something on a 2-level topology."""
+    from tpu_compressed_dp.utils.meters import per_fabric_comm_bytes
+
+    if pods <= 1:
+        return {}
+    fabric = per_fabric_comm_bytes(comm_means, world, pods)
+    if fabric is None:
+        return {}
+    ici_b, dcn_b = fabric
+    out = {"net/dcn_mb_per_step": dcn_b / 1e6}
+    if seconds > 0 and steps > 0:
+        rate = steps / seconds
+        out["net/dcn_gbps_per_chip"] = dcn_b * rate * 8 / 1e9
+        out["net/ici_gbps_per_chip"] = ici_b * rate * 8 / 1e9
+    return out
 
 
 def job_scoped(args, path):
@@ -554,6 +595,7 @@ def train_epoch(
     guard_cfg=None,
     timeline=None,
     world: Optional[int] = None,
+    pods: int = 1,
     elastic=None,
     preempt=None,
 ) -> Tuple[TrainState, Dict[str, float], MetricAccumulator]:
@@ -586,9 +628,14 @@ def train_epoch(
     if world:
         from tpu_compressed_dp.utils.meters import per_chip_comm_bytes
 
-        comm_b = per_chip_comm_bytes(
-            {k: train_acc.mean(k) for k in train_acc.sums
-             if k.startswith("comm/")}, world)
+        comm_means = {k: train_acc.mean(k) for k in train_acc.sums
+                      if k.startswith("comm/")}
+        comm_b = per_chip_comm_bytes(comm_means, world, pods)
         if comm_b is not None and train_time > 0:
             summary["comm MB/s"] = comm_b * train_acc.steps / train_time / 1e6
+        gauges = fabric_gauges(comm_means, world, pods, train_acc.steps,
+                               train_time)
+        if gauges:
+            summary["dcn MB/s"] = (gauges.get("net/dcn_gbps_per_chip", 0.0)
+                                   * 1e3 / 8)
     return state, summary, train_acc

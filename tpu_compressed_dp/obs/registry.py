@@ -96,6 +96,15 @@ declare("comm/sent_bits_allgather", COUNTER, "bits", "mean", "engine",
 declare("comm/sent_bits_alltoall", COUNTER, "bits", "mean", "engine",
         "payload bits riding the sharded transport's all_to_all route "
         "((W-1)/W per-chip traffic)")
+declare("comm/sent_bits_ici", COUNTER, "bits", "mean", "engine",
+        "hierarchical transport: bits on the fast intra-pod ICI fabric "
+        "(the dense pod psums; 2(C-1)/C per-chip traffic within a pod)")
+declare("comm/sent_bits_dcn", COUNTER, "bits", "mean", "engine",
+        "hierarchical transport: bits crossing the slow inter-pod DCN "
+        "fabric (sparse route + shard return; the binding constraint)")
+declare("comm/sent_bits_dcn_route", COUNTER, "bits", "mean", "engine",
+        "the all_to_all route share of sent_bits_dcn ((P-1)/P per-chip; "
+        "the remainder is the (P-1)x shard-return all_gather)")
 declare("comm/dense_elems", GAUGE, "elems", "mean", "engine",
         "uncompressed gradient size (the compression denominator)")
 declare("comm/num_collectives", GAUGE, "collectives", "mean", "engine",
@@ -153,6 +162,14 @@ declare("net/allreduce_gbps_per_chip", GAUGE, "Gb/s", "mean", "host",
         "per-chip ring-allreduce traffic rate over the NetMeter window")
 declare("net/compression_frac", GAUGE, "ratio", "mean", "host",
         "wire payload / dense gradient bytes over the NetMeter window")
+declare("net/dcn_mb_per_step", GAUGE, "MB", "mean", "host",
+        "per-chip bytes crossing the inter-pod DCN fabric per step "
+        "(hierarchical transport; 0 on a flat mesh)")
+declare("net/dcn_gbps_per_chip", GAUGE, "Gb/s", "mean", "host",
+        "per-chip DCN traffic rate over the NetMeter window — the number "
+        "to hold under the inter-pod link budget")
+declare("net/ici_gbps_per_chip", GAUGE, "Gb/s", "mean", "host",
+        "per-chip intra-pod ICI traffic rate over the NetMeter window")
 declare("net/recv_gbit_s", GAUGE, "Gb/s", "mean", "host",
         "received Gbit/s at the measured step rate (TB net/ tab parity "
         "with the reference's in_gb counters)")

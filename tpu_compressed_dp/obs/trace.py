@@ -41,7 +41,12 @@ __all__ = ["PHASES", "phase", "chunk", "host_span", "StepTimeline",
 #:   reduce    the reduction collective (psum / owner scatter-add)
 #:   return    un-flatten / shard-return all_gather back to leaf shapes
 #:   update    optimizer apply
-PHASES = ("grad", "ef", "compress", "route", "reduce", "return", "update")
+#:   ici_reduce  hierarchical transport: dense intra-pod psum (both the
+#:             contribution-in and combined-partial-out hops)
+#:   recompress  hierarchical transport: pack + slice the pod-reduced
+#:             gradient's nonzero union for the inter-pod exchange
+PHASES = ("grad", "ef", "compress", "route", "reduce", "return", "update",
+          "ici_reduce", "recompress")
 
 
 def phase(name: str):

@@ -58,7 +58,8 @@ Array = jax.Array
 
 __all__ = ["ShardPlan", "make_shard_plan", "sharded_payload_bits",
            "sharded_combine", "owner_of_unit", "owner_bounds",
-           "SHARDED_METHODS"]
+           "SHARDED_METHODS", "HierPlan", "make_hier_plan",
+           "hier_axis_groups", "hier_payload_bits"]
 
 # The wire methods whose payloads carry explicit indices and therefore have
 # a sharded form.  Quantizers (terngrad/qsgd) ship dense per-worker codes
@@ -162,6 +163,97 @@ def sharded_payload_bits(n_units: int, keep: int, world: int, unit_size: int,
     return route, ret
 
 
+@dataclasses.dataclass(frozen=True)
+class HierPlan:
+    """Static geometry of one group's two-level hierarchical combine
+    (``transport='hierarchical'``): a ``pods x chips`` virtual 2-axis view
+    of the flat dp mesh, with dense ICI psums inside each pod and the
+    owner-sharded sparse exchange (:class:`ShardPlan` over ``pods``
+    senders) across the DCN axis.
+    """
+
+    n: int          # elements in the group's flat space
+    keep: int       # per-worker selection size (elements)
+    world: int      # W = pods * chips (the flat dp axis size)
+    pods: int       # P: DCN-connected pod count
+    chips: int      # C: ICI-connected chips per pod
+    cap_union: int  # recompress: pod-union buffer capacity (multiple of C)
+    slab: int       # cap_union // chips — one chip's slice of the union
+    dcn: ShardPlan  # the inter-pod exchange (world=pods, keep=slab)
+
+
+def hier_axis_groups(world: int, pods: int):
+    """The two ``axis_index_groups`` partitions of the flat dp axis.
+
+    ICI groups — one per pod, ``chips`` contiguous ranks each (rank ``g``
+    lives in pod ``g // chips`` at chip-rank ``g % chips``); DCN groups —
+    one per chip-rank, ``pods`` ranks each (the rank-``c`` column across
+    pods), so the ``chips`` inter-pod exchanges run in parallel over
+    disjoint slabs of each pod's union buffer."""
+    if world % pods:
+        raise ValueError(
+            f"dp_pods={pods} must divide the dp world size {world} "
+            "(the virtual mesh is pods x chips with no ragged pod)")
+    chips = world // pods
+    ici = [[p * chips + c for c in range(chips)] for p in range(pods)]
+    dcn = [[p * chips + c for p in range(pods)] for c in range(chips)]
+    return ici, dcn
+
+
+def make_hier_plan(n: int, keep: int, world: int, pods: int,
+                   route_factor_ici: float, route_factor_dcn: float
+                   ) -> HierPlan:
+    """Size the hierarchical transport's buffers for one group, statically.
+
+    ``cap_union = route_factor_ici * keep`` is the recompress capacity for
+    the pod-reduced gradient's nonzero union: with the worker-overlap
+    premise the union is ~``keep`` (factor 1 would already hold it), and a
+    disjoint-selection worst case needs ``chips * keep`` — the factor is
+    the knob between them, clamped to the (chip-rounded) group size and
+    rounded up to a multiple of ``chips`` so the buffer slices evenly into
+    per-chip slabs.  The DCN exchange is an ordinary :class:`ShardPlan`
+    over ``pods`` senders whose per-sender payload is one ``slab``.
+    """
+    if world % pods:
+        raise ValueError(
+            f"dp_pods={pods} must divide the dp world size {world}")
+    chips = world // pods
+    cap = max(chips, int(round(route_factor_ici * max(keep, 1))))
+    cap = -(-cap // chips) * chips
+    cap = min(cap, -(-n // chips) * chips)
+    slab = cap // chips
+    dcn = make_shard_plan(n, slab, pods, 1, route_factor_dcn,
+                          route_factor_dcn)
+    return HierPlan(n, keep, world, pods, chips, cap, slab, dcn)
+
+
+def hier_payload_bits(n: int, keep: int, world: int, pods: int,
+                      route_factor_ici: float, route_factor_dcn: float
+                      ) -> Tuple[float, float, float]:
+    """Analytic ``(ici_bits, dcn_route_bits, dcn_return_bits)`` per chip
+    for one hierarchical group — the same arithmetic the wire engine
+    measures off its actual buffers, so simulate and wire accounting agree
+    for this transport too.
+
+    ICI carries the two dense pod psums (the compressed-dense contribution
+    in, the combined partial back out: ``2 * n * 32`` bits; zero when each
+    pod is a single chip, one psum when there is a single pod — that lone
+    psum already reduces the whole world).  DCN carries the per-chip slab's
+    route ``all_to_all`` and shard-return ``all_gather`` exactly as billed
+    by :func:`sharded_payload_bits` over ``pods`` senders."""
+    p = make_hier_plan(n, keep, world, pods, route_factor_ici,
+                       route_factor_dcn)
+    if p.pods == 1:
+        return (float(n * 32) if p.chips > 1 else 0.0), 0.0, 0.0
+    ici = float(2 * n * 32) if p.chips > 1 else 0.0
+    route = float(p.dcn.world * p.dcn.cap_dest * 32 * 2)
+    if p.dcn.dense_return:
+        ret = float(p.dcn.shard_n * 32)
+    else:
+        ret = float(p.dcn.cap_ret * 32 * 2)
+    return ici, route, ret
+
+
 def _per_dest_slots(idx: Array, valid: Optional[Array], plan: ShardPlan
                     ) -> Tuple[Array, Array, Array]:
     """Assign each payload slot its route bucket position.
@@ -193,9 +285,17 @@ def _per_dest_slots(idx: Array, valid: Optional[Array], plan: ShardPlan
 
 
 def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
-                    axis_name: str, valid: Optional[Array] = None):
+                    axis_name: str, valid: Optional[Array] = None,
+                    axis_index_groups=None):
     """Route -> owner-reduce -> return one group's ``(values, indices)``
     payload; must run inside ``shard_map`` over ``axis_name``.
+
+    ``axis_index_groups`` restricts the exchange to disjoint subgroups of
+    the axis (the hierarchical transport's DCN columns): ``plan.world``
+    must then equal the group size, and the returned ``dense_units`` is the
+    sum over THIS group's members only.  Grouped gathers use plain
+    ``jax.lax.all_gather`` — the result genuinely differs across groups, so
+    the replication-carrying invariant gather would be a lie.
 
     ``vals``: ``[keep]`` (element units) or ``[keep, unit_size]`` (block
     units); ``idx``: ``[keep]`` ascending int32 unit indices; ``valid``:
@@ -220,6 +320,13 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
 
     W, cap, shard_n = plan.world, plan.cap_dest, plan.shard_n
     blocky = vals.ndim == 2
+    if axis_index_groups is None:
+        def gather(a):
+            return _all_gather(a, axis_name)
+    else:
+        def gather(a):
+            return jax.lax.all_gather(a, axis_name,
+                                      axis_index_groups=axis_index_groups)
     slot, accepted, dest = _per_dest_slots(idx, valid, plan)
     local = (idx - dest * shard_n).astype(jnp.int32)
 
@@ -241,8 +348,11 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
         bvals = bvals.reshape((W, cap) + vals.shape[1:])
         bidx = bidx.reshape(W, cap)
         route_bits = _payload_bits(bvals, bidx)
-        rvals = jax.lax.all_to_all(bvals, axis_name, 0, 0)   # [W, cap(, bs)]
-        ridx = jax.lax.all_to_all(bidx, axis_name, 0, 0)
+        rvals = jax.lax.all_to_all(
+            bvals, axis_name, 0, 0,
+            axis_index_groups=axis_index_groups)             # [W, cap(, bs)]
+        ridx = jax.lax.all_to_all(bidx, axis_name, 0, 0,
+                                  axis_index_groups=axis_index_groups)
 
     # --- owner reduce: W*cap scatter-adds into the dense shard ----------
     # shard_n + 1 rows: the last is the padding guard row, sliced off
@@ -273,7 +383,7 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
     # --- return ---------------------------------------------------------
     if plan.dense_return:
         with obs_trace.phase("return"):
-            g = _all_gather(shard, axis_name)            # [W, shard_n(, bs)]
+            g = gather(shard)                            # [W, shard_n(, bs)]
             dense = g.reshape((W * shard_n,) + vals.shape[1:])
         return_bits = _payload_bits(shard)
         sent = accepted
@@ -293,8 +403,8 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
         sel = jnp.where(rvalid[(...,) + (None,) * (vals.ndim - 1)], sel, 0)
         rix = jnp.where(rvalid, rix, 0)
         return_bits = _payload_bits(sel, rix)
-        g_vals = _all_gather(sel, axis_name)             # [W, cap_ret(, bs)]
-        g_rix = _all_gather(rix, axis_name)              # [W, cap_ret]
+        g_vals = gather(sel)                             # [W, cap_ret(, bs)]
+        g_rix = gather(rix)                              # [W, cap_ret]
         offs = jnp.arange(W, dtype=jnp.int32)[:, None] * shard_n
         gidx = (g_rix + offs).reshape(-1)
         dense = jnp.zeros((W * shard_n,) + vals.shape[1:], vals.dtype

@@ -21,7 +21,8 @@ import time
 from typing import Dict, Optional, Tuple
 
 __all__ = ["TimeMeter", "NetworkMeter", "CommMeter", "GuardMeter",
-           "network_bytes", "per_chip_traffic_bytes", "per_chip_comm_bytes"]
+           "network_bytes", "per_chip_traffic_bytes", "per_chip_comm_bytes",
+           "per_fabric_traffic_bytes", "per_fabric_comm_bytes"]
 
 
 def per_chip_traffic_bytes(psum_bytes: float, allgather_bytes: float,
@@ -47,19 +48,71 @@ def per_chip_traffic_bytes(psum_bytes: float, allgather_bytes: float,
             + (world - 1) / max(world, 1) * alltoall_bytes)
 
 
-def per_chip_comm_bytes(m: Dict[str, float], world: int) -> Optional[float]:
+def per_fabric_traffic_bytes(psum_bytes: float, allgather_bytes: float,
+                             world: int, alltoall_bytes: float = 0.0,
+                             ici_bytes: float = 0.0,
+                             dcn_route_bytes: float = 0.0,
+                             dcn_return_bytes: float = 0.0,
+                             pods: int = 1) -> Tuple[float, float]:
+    """Per-chip link traffic split ``(ici_bytes, dcn_bytes)`` for one sync
+    on a ``pods x (world/pods)`` virtual mesh.
+
+    The hierarchical transport's group collectives bill per fabric
+    directly: the dense pod psums ride the ``C = world/pods``-chip
+    intra-pod ring (``2(C-1)/C x`` their summed payload); the inter-pod
+    route is an all_to_all over ``pods`` participants (``(P-1)/P x``) and
+    the shard return an all_gather (``(P-1) x``).  Whole-world collectives
+    (the flat psum/allgather/alltoall buckets from non-hierarchical
+    groups) span BOTH fabrics; they bill to DCN when ``pods > 1`` — the
+    slow fabric is the binding constraint a whole-world ring is limited by
+    — and to ICI on a flat mesh (``pods == 1``), where they are the whole
+    story and ``dcn == 0``.
+    """
+    pods = max(pods, 1)
+    chips = max(world // pods, 1)
+    flat = per_chip_traffic_bytes(psum_bytes, allgather_bytes, world,
+                                  alltoall_bytes)
+    ici = 2 * (chips - 1) / chips * ici_bytes
+    dcn = ((pods - 1) / pods * dcn_route_bytes
+           + (pods - 1) * dcn_return_bytes)
+    if pods > 1:
+        dcn += flat
+    else:
+        ici += flat
+    return ici, dcn
+
+
+def per_chip_comm_bytes(m: Dict[str, float], world: int,
+                        pods: int = 1) -> Optional[float]:
     """Per-chip link bytes of ONE step from a ``comm/*`` metrics dict
     (per-step values or epoch means), applying the transport split through
-    :func:`per_chip_traffic_bytes`.  None when comm metrics are absent
+    :func:`per_chip_traffic_bytes` (plus the hierarchical transport's
+    per-fabric terms when present).  None when comm metrics are absent
     (compression off).  The single epilogue all three harnesses use for
     their comm-bytes/s column, so they can never disagree on the
     arithmetic."""
+    fabric = per_fabric_comm_bytes(m, world, pods)
+    if fabric is None:
+        return None
+    return fabric[0] + fabric[1]
+
+
+def per_fabric_comm_bytes(m: Dict[str, float], world: int,
+                          pods: int = 1) -> Optional[Tuple[float, float]]:
+    """``(ici_bytes, dcn_bytes)`` per chip for ONE step from a ``comm/*``
+    metrics dict — :func:`per_fabric_traffic_bytes` fed from the engines'
+    billed split.  None when comm metrics are absent."""
     if "comm/sent_bits" not in m:
         return None
     psum_b = float(m.get("comm/sent_bits_psum", m["comm/sent_bits"])) / 8
     ag_b = float(m.get("comm/sent_bits_allgather", 0.0)) / 8
     a2a_b = float(m.get("comm/sent_bits_alltoall", 0.0)) / 8
-    return per_chip_traffic_bytes(psum_b, ag_b, world, a2a_b)
+    ici_b = float(m.get("comm/sent_bits_ici", 0.0)) / 8
+    dcn_b = float(m.get("comm/sent_bits_dcn", 0.0)) / 8
+    rt_b = float(m.get("comm/sent_bits_dcn_route", 0.0)) / 8
+    return per_fabric_traffic_bytes(
+        psum_b, ag_b, world, a2a_b, ici_b, rt_b, max(dcn_b - rt_b, 0.0),
+        pods)
 
 
 class TimeMeter:
