@@ -16,8 +16,10 @@ from tpu_compressed_dp.analysis.spmd import (check_barrier_chain,
                                              check_chunk_plan,
                                              check_control_flow,
                                              check_donation,
+                                             check_jaxpr_budget,
                                              check_signature_match,
-                                             collective_signature)
+                                             collective_signature,
+                                             count_eqns)
 from tpu_compressed_dp.compat import shard_map
 from tpu_compressed_dp.parallel.mesh import make_data_mesh
 
@@ -168,6 +170,38 @@ class TestChunkPlan:
         plans = [_plan(0, 0, 2, 0, 2), _plan(1, 3, 5, 2, 2)]
         out = check_chunk_plan(plans, n_leaves=5, n_groups=4)
         assert "TCDP004" in _codes(out)
+
+
+class TestJaxprBudget:
+    def test_unrolled_loop_fires(self):
+        # the TCDP005 failure shape: a Python loop over "leaves" stamping
+        # its body into the trace once per iteration
+        def f(x):
+            for _ in range(64):
+                x = jnp.sin(x) * 2.0 + 1.0
+            return x
+
+        jx = jax.make_jaxpr(f)(jnp.ones((4,)))
+        out = check_jaxpr_budget(jx, budget=100, config="fix")
+        assert _codes(out) == ["TCDP005"]
+        assert "budget 100" in out[0].message
+
+    def test_rolled_loop_passes(self):
+        # the same computation as a fori_loop counts its body ONCE
+        def f(x):
+            return jax.lax.fori_loop(
+                0, 64, lambda i, v: jnp.sin(v) * 2.0 + 1.0, x)
+
+        jx = jax.make_jaxpr(f)(jnp.ones((4,)))
+        assert count_eqns(jx) < 64
+        assert check_jaxpr_budget(jx, budget=100) == []
+
+    def test_count_recurses_into_subjaxprs(self):
+        def f(x):
+            return jax.jit(lambda v: jnp.sin(v) + jnp.cos(v))(x)
+
+        jx = jax.make_jaxpr(f)(jnp.ones((4,)))
+        assert count_eqns(jx) >= 3  # pjit eqn + sin + cos + add inside
 
 
 class TestBarrierChain:

@@ -419,3 +419,261 @@ class TestSegPack:
         assert not K.use_seg_pack(1 << 20, (1 << 20) // 10)
         # int32 gate
         assert not K.use_seg_pack((1 << 31) + 10, 1000)
+
+
+class TestFusedSelectPack:
+    """One-pass select+pack vs the XLA mask -> packed_indices_from_mask ->
+    sorted-gather chain: the payloads must be BITWISE identical (values,
+    indices, and survivor count) whenever the mask fills the buffer —
+    exactly the regime the top-k histogram threshold guarantees."""
+
+    def _xla(self, flat, mag, t, keep):
+        from tpu_compressed_dp.ops import wire
+
+        mask = mag >= t
+        idx = wire.packed_indices_from_mask(mask, keep)
+        return (wire._sorted_gather(flat, idx), idx,
+                jnp.sum(mask, dtype=jnp.int32))
+
+    # tier-1 parity core: the multi-chunk ragged case in both dtypes plus
+    # the keep=1 and keep=n extremes; the full size x dtype cross rides
+    # `-m slow` with the rest of the wire matrix (each row pays ~2 s of
+    # interpreter compile, and tier-1 runs against a fixed wall budget)
+    @pytest.mark.parametrize("n,keep,dtype", [
+        (70000, 700, jnp.float32),
+        (70000, 700, jnp.bfloat16),
+        (65536, 1, jnp.float32),
+        (4096, 4096, jnp.float32),
+        pytest.param(65536, 1, jnp.bfloat16, marks=pytest.mark.slow),
+        pytest.param(4096, 4096, jnp.bfloat16, marks=pytest.mark.slow),
+        pytest.param(12345, 300, jnp.float32, marks=pytest.mark.slow),
+        pytest.param(12345, 300, jnp.bfloat16, marks=pytest.mark.slow),
+    ])
+    def test_bitwise_parity_topk(self, n, keep, dtype):
+        flat = jax.random.normal(jax.random.key(n + keep), (n,), dtype)
+        mag = jnp.abs(flat).astype(jnp.float32)
+        t = kernels.topk_threshold(mag, keep)
+        fv, fi, fc = kernels.fused_select_pack(flat, t, keep, interpret=True)
+        xv, xi, xc = self._xla(flat, mag, t, keep)
+        assert np.array_equal(np.asarray(fi), np.asarray(xi))
+        assert np.array_equal(np.asarray(fv), np.asarray(xv))
+        assert int(fc) == int(xc)
+        assert fv.dtype == flat.dtype
+
+    def test_blocktopk_scores_parity(self):
+        # block scores are non-negative and serve as their own magnitudes
+        flat = jax.random.normal(jax.random.key(4), (40960,))
+        scores = compressors.blocktopk_scores(flat, 256)
+        kb = 16
+        t = kernels.topk_threshold(scores, kb)
+        fv, fi, fc = kernels.fused_select_pack(scores, t, kb, interpret=True)
+        _, xi, xc = self._xla(scores, scores, t, kb)
+        assert np.array_equal(np.asarray(fi), np.asarray(xi))
+        assert int(fc) == int(xc)
+
+    def test_monotone_invariant_on_fused_output(self):
+        # full buffer -> strictly ascending unique indices: the downstream
+        # sorted/unique scatter hints depend on this
+        from tpu_compressed_dp.ops import wire
+
+        flat = jax.random.normal(jax.random.key(5), (30000,))
+        t = kernels.topk_threshold(jnp.abs(flat), 300)
+        _, fi, _ = kernels.fused_select_pack(flat, t, 300, interpret=True)
+        assert bool(wire.packed_indices_monotone(fi))
+
+    def test_underfull_pads_zero_value_zero_index(self):
+        # an underfull mask (threshold above every |x|) pads (0.0, 0) —
+        # scatter-add identities, unlike the XLA chain's flat[0] replication
+        flat = jnp.arange(1.0, 5001.0)
+        fv, fi, fc = kernels.fused_select_pack(
+            flat, jnp.float32(4998.5), 10, interpret=True)
+        assert int(fc) == 2
+        np.testing.assert_array_equal(
+            np.asarray(fv), [4999.0, 5000.0] + [0.0] * 8)
+        np.testing.assert_array_equal(np.asarray(fi), [4998, 4999] + [0] * 8)
+
+    def test_dispatch_gate(self):
+        assert not kernels.use_select_pack(1 << 10, 8)   # below size floor
+        assert not kernels.use_select_pack(1 << 20, 0)   # degenerate keep
+        assert not kernels.use_select_pack((1 << 31) + 2, 100)  # int32 wrap
+
+
+class TestQuantPackKernels:
+    """Matmul bit-packing vs the XLA shift/sum packers: wire BYTES must be
+    bitwise identical (the receiver's unpack is shared)."""
+
+    @pytest.mark.parametrize("n", [70000, 12345, 65533, 7])
+    def test_pack_ternary_parity(self, n):
+        from tpu_compressed_dp.ops import wire
+
+        rng = np.random.default_rng(n)
+        levels = jnp.asarray(rng.integers(-1, 2, n), jnp.int8)
+        got = kernels.pack_ternary_pallas(levels, interpret=True)
+        want = wire.pack_ternary(levels)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("n", [70000, 12347])
+    def test_qsgd_pack_levels_parity(self, n):
+        from tpu_compressed_dp.ops import wire
+
+        rng = np.random.default_rng(n)
+        levels = jnp.asarray(rng.integers(-255, 256, n), jnp.int16)
+        gm, gs = kernels.qsgd_pack_pallas(levels, interpret=True)
+        wm, ws = wire.qsgd_wire_pack(levels, 255)
+        assert np.array_equal(np.asarray(gm), np.asarray(wm))
+        assert np.array_equal(np.asarray(gs), np.asarray(ws))
+
+    @pytest.mark.skipif(
+        not compat.HAS_TPU_INTERPRET,
+        reason="fused quantize+pack draws from the TPU hardware PRNG; the "
+               "stock HLO interpreter has no prng_seed lowering")
+    def test_terngrad_pack_bytes(self):
+        from tpu_compressed_dp.ops import wire
+
+        g = jax.random.normal(jax.random.key(2), (20000,))
+        packed, scale = kernels.terngrad_pack(g, jax.random.key(3),
+                                              interpret=True)
+        assert packed.dtype == jnp.uint8 and packed.shape == (-(-20000 // 4),)
+        lv = wire.unpack_ternary(packed[None], 20000)[0]
+        assert set(np.unique(np.asarray(lv))) <= {-1, 0, 1}
+        assert float(scale) == pytest.approx(float(jnp.max(jnp.abs(g))))
+
+    @pytest.mark.skipif(
+        not compat.HAS_TPU_INTERPRET,
+        reason="fused quantize+pack draws from the TPU hardware PRNG")
+    def test_qsgd_pack_bytes(self):
+        g = jax.random.normal(jax.random.key(6), (20000,))
+        mags, signs, scale = kernels.qsgd_pack(g, jax.random.key(7),
+                                               interpret=True)
+        assert mags.dtype == jnp.uint8 and signs.dtype == jnp.uint8
+        assert mags.shape == (20000,) and signs.shape == (-(-20000 // 8),)
+        # u=0 stub -> levels == floor(|g|/norm * s) exactly
+        ref = np.floor(np.abs(np.asarray(g))
+                       / np.linalg.norm(np.asarray(g)) * 255)
+        np.testing.assert_array_equal(np.asarray(mags), ref)
+
+    def test_dispatch_gate_excludes_uninterpretable_backends(self):
+        kernels.set_pallas_mode("force")
+        try:
+            import jax as _jax
+            expected = (_jax.default_backend() == "tpu"
+                        or compat.HAS_TPU_INTERPRET)
+            assert kernels.use_quant_pack(1 << 20) == expected
+        finally:
+            kernels.set_pallas_mode("off")
+
+
+class TestFusedBucketRoute:
+    """Fused per-destination bucket build vs the XLA slot scatter in
+    wire_sharded: buckets must be bitwise identical, monotone rows kept."""
+
+    def _xla(self, vals, idx, valid, W, cap, shard_n):
+        dest = jnp.minimum(idx // shard_n, W - 1).astype(jnp.int32)
+        if valid is not None:
+            dest = jnp.where(valid, dest, W)
+        counts = jnp.zeros((W + 1,), jnp.int32).at[dest].add(
+            1, indices_are_sorted=True, mode="promise_in_bounds")
+        starts = jnp.cumsum(counts) - counts
+        rank = jnp.arange(idx.shape[0], dtype=jnp.int32) - starts[dest]
+        accepted = rank < cap
+        if valid is not None:
+            accepted = accepted & valid
+        slot = jnp.where(accepted, dest * cap + rank, W * cap)
+        local = (idx - dest * shard_n).astype(jnp.int32)
+        bvals = jnp.zeros((W * cap + 1,), vals.dtype).at[slot].add(vals)[:-1]
+        bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
+                        ).at[slot].set(local)[:-1]
+        return bvals.reshape(W, cap), bidx.reshape(W, cap), dest
+
+    @pytest.mark.parametrize("seed,n,keep,W", [(0, 70000, 700, 8),
+                                               (1, 30000, 333, 4)])
+    def test_bitwise_parity(self, seed, n, keep, W):
+        rng = np.random.default_rng(seed)
+        pick = np.sort(rng.choice(n, keep, replace=False))
+        idx = jnp.asarray(pick, jnp.int32)
+        vals = jnp.asarray(rng.standard_normal(keep), jnp.float32)
+        shard_n = -(-n // W)
+        cap = max(1, int(1.25 * keep / W))
+        xv, xi, dest = self._xla(vals, idx, None, W, cap, shard_n)
+        fv, fi = kernels.fused_bucket_route(vals, idx, dest, W, cap,
+                                            shard_n, interpret=True)
+        assert np.array_equal(np.asarray(fv), np.asarray(xv))
+        assert np.array_equal(np.asarray(fi), np.asarray(xi))
+
+    def test_valid_prefix_routes_to_dump(self, ):
+        # threshold-style zero-padded tails (valid prefix) must not consume
+        # any bucket capacity
+        rng = np.random.default_rng(2)
+        n, keep, nvalid, W = 40000, 77, 60, 8
+        pick = np.sort(rng.choice(n, nvalid, replace=False))
+        idx = jnp.asarray(np.concatenate([pick, np.zeros(keep - nvalid)]),
+                          jnp.int32)
+        vals = jnp.asarray(
+            np.concatenate([rng.standard_normal(nvalid),
+                            np.zeros(keep - nvalid)]), jnp.float32)
+        valid = jnp.arange(keep) < nvalid
+        shard_n = -(-n // W)
+        cap = 13
+        xv, xi, dest = self._xla(vals, idx, valid, W, cap, shard_n)
+        fv, fi = kernels.fused_bucket_route(vals, idx, dest, W, cap,
+                                            shard_n, interpret=True)
+        assert np.array_equal(np.asarray(fv), np.asarray(xv))
+        assert np.array_equal(np.asarray(fi), np.asarray(xi))
+        # monotone rows: filled ascending prefix then constant shard_n tail
+        for w in range(W):
+            row = np.asarray(fi[w])
+            filled = row[row < shard_n]
+            assert np.all(np.diff(filled) > 0)
+
+    def test_dispatch_gate(self):
+        assert not kernels.use_bucket_route(1 << 10, 8, 64)   # size floor
+        assert not kernels.use_bucket_route(1 << 20, 1, 64)   # no routing
+        assert not kernels.use_bucket_route(1 << 20, 8, 1 << 20)  # cap blowup
+
+
+class TestPoisonedTailHistogram:
+    """A NaN/Inf guard-vetoed gradient must not collapse the histogram bin
+    edges: a non-finite ``max(mag)`` used to propagate into every edge
+    (``x >= NaN`` is false everywhere), driving the survivor count to zero,
+    underfilling the pack, and voiding the sorted/unique scatter hints.
+    The FP32_MAX clamp keeps the structural ``count >= keep`` guarantee —
+    degraded resolution (t -> 0, EF reabsorbs the surplus), never a
+    duplicate-index payload.  The -1.0 padding fill stays strictly below
+    every edge, so padding lanes never leak into the counts either."""
+
+    @pytest.mark.parametrize("poison", ["nan", "inf", "both"])
+    def test_pallas_histogram_guarantee_survives(self, poison):
+        mag = jnp.abs(jax.random.normal(jax.random.key(11), (10000,)))
+        if poison in ("nan", "both"):
+            mag = mag.at[17].set(jnp.nan)
+        if poison in ("inf", "both"):
+            mag = mag.at[4242].set(jnp.inf)
+        t = kernels._topk_threshold_pallas(mag, 100, interpret=True)
+        assert bool(jnp.isfinite(t))
+        assert int(jnp.sum(mag >= t)) >= 100  # NaN compares false: vetoed
+
+    @pytest.mark.parametrize("poison", ["nan", "inf"])
+    def test_jnp_fallback_guarantee_survives(self, poison):
+        mag = jnp.abs(jax.random.normal(jax.random.key(12), (4096,)))
+        mag = mag.at[7].set(jnp.nan if poison == "nan" else jnp.inf)
+        t = kernels._topk_threshold_jnp(mag, 41)
+        assert bool(jnp.isfinite(t))
+        assert int(jnp.sum(mag >= t)) >= 41
+
+    def test_exact_path_nan_demoted_below_topk(self):
+        # the exact lax.top_k dispatch path: NaN sorts as LARGEST and would
+        # steal a slot, landing the threshold one rank too high (underfull
+        # pack).  The demotion keeps count(mag >= t) >= keep with NaN vetoed.
+        mag = jnp.abs(jax.random.normal(jax.random.key(14), (70000,)))
+        mag = mag.at[123].set(jnp.nan)
+        t = kernels.topk_threshold(mag, 700)
+        assert int(jnp.sum(mag >= t)) >= 700
+        assert not bool(jnp.isnan(mag[123]) & (mag[123] >= t))
+
+    def test_finite_inputs_unchanged(self):
+        # the clamp must be invisible for ordinary finite gradients
+        mag = jnp.abs(jax.random.normal(jax.random.key(13), (8192,)))
+        t = kernels._topk_threshold_pallas(mag, 80, interpret=True)
+        exact = jax.lax.top_k(mag, 80)[0][-1]
+        np.testing.assert_array_equal(np.asarray(mag >= t),
+                                      np.asarray(mag >= exact))

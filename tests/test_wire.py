@@ -557,6 +557,28 @@ class TestBlockTopKWire:
         assert np.isfinite(ef0).all()
         assert (ef0[0:8] == 0).all()
 
+    def test_topk_poisoned_tail_keeps_payload_monotone(self, mesh8):
+        """Poisoned-tail regression (histogram-edge clamp): a NaN in the
+        gradient must not collapse the top-k histogram edges — pre-clamp a
+        non-finite ``max(mag)`` made every edge NaN, the survivor count
+        dropped below ``keep``, and the underfull pack padded duplicate
+        index 0, voiding the sorted/unique scatter hints downstream.  The
+        select must stay a veto (NaN never travels) with a full, strictly
+        monotone payload."""
+        from tpu_compressed_dp.ops import wire as wire_mod
+
+        n, keep = 70000, 700
+        g = np.random.default_rng(1).standard_normal(n).astype(np.float32)
+        g[123] = np.nan
+        flat = jnp.asarray(g)
+        from tpu_compressed_dp.ops import kernels
+        t = kernels.topk_threshold(jnp.abs(flat).astype(jnp.float32), keep)
+        _, idx, count = wire_mod._select_pack(
+            flat, jnp.abs(flat).astype(jnp.float32), t, keep)
+        assert int(count) >= keep            # no underfull pack
+        assert bool(wire_mod.packed_indices_monotone(idx))
+        assert 123 not in np.asarray(idx)    # the NaN coordinate is vetoed
+
 class TestBucketedWire:
     def test_bucketed_wire_matches_simulate(self, mesh8):
         # multi-leaf buckets through the wire path: same grouping and keys as

@@ -309,7 +309,12 @@ def main(argv=None):
         f"# collective — backward work still available to hide it behind.",
         f"# 0.0 => the collective runs fully exposed at the step tail.",
         f"# chunk: the tcdp.chunk<ii> overlap scope that issued the",
-        f"# collective (sync_overlap=K rows; '-' = unchunked).", ""]
+        f"# collective (sync_overlap=K rows; '-' = unchunked).",
+        f"# head: model-compute instructions scheduled BEFORE the earliest",
+        f"# collective — the serial head-of-chunk latency (threshold +",
+        f"# select + pack before chunk 0's collective can issue) that caps",
+        f"# the overlap pipeline's depth; the fused compressor kernels",
+        f"# exist to shrink exactly this segment.", ""]
     summaries = {}
     for case in cases:
         label, method, gran, overlap, bucket_mb, mode, transport = case[:7]
@@ -325,6 +330,7 @@ def main(argv=None):
         rows, total_c, upd = schedule_stats(txt)
         sched = "yes" if "is_scheduled=true" in txt else "NO"
         first, mean, last = case_summary(rows)
+        head = total_c - max((r["compute_after"] for r in rows), default=0)
         summaries[label] = (first, mean, last, len(rows))
         out_lines.append(
             f"== {label}: {len(rows)} collective instr "
@@ -339,7 +345,8 @@ def main(argv=None):
                 f"({100*r['compute_after_frac']:5.1f}%)")
         out_lines.append(
             f"   summary: first={100*first:.1f}% mean={100*mean:.1f}% "
-            f"last={100*last:.1f}%")
+            f"last={100*last:.1f}% head={head} instr "
+            f"({100 * head / max(total_c, 1):.1f}%)")
         for ln in out_lines[-(len(rows) + 2):]:
             print(ln)
     out_lines.append(

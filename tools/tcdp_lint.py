@@ -6,7 +6,11 @@ to jaxprs on a virtual CPU mesh and verifies the SPMD safety contract:
 no collectives under worker-divergent control flow (TCDP001), ordered
 collective-signature determinism across retraces / engine pairs / the
 chunked schedule (TCDP002), donation that can actually alias (TCDP003),
-and overlap chunk-plan + optimization_barrier chain integrity (TCDP004).
+overlap chunk-plan + optimization_barrier chain integrity (TCDP004), and
+per-config jaxpr equation budgets that catch accidental unrolling
+(TCDP005).  The trace matrix includes the fused compressor kernels under
+``pallas_mode`` off AND force, pinning the collective signature across
+the kernel toggle.
 
 Pass 2 (``--host``) is an AST walk over the package and ``tools/``
 enforcing host-side invariants: no wall-clock reads in replay-

@@ -180,10 +180,12 @@ def _pack_scatter_chain(n: int, keep: int, axis_name: str = "data"):
 
 def _sharded_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
     """Stage ladder for the OWNER-SHARDED transport (transport='sharded'):
-    mag -> threshold -> pack -> gather -> route (bucket build + all_to_all)
-    -> reduce (owner scatter-add) -> return (shard all_gather + scatter/
-    concat) -> ef.  Mirrors ops/wire_sharded.sharded_combine — update both
-    together.  On one device the collectives are self-copies, so the route/
+    mag -> threshold -> select_pack (the shipped `wire._select_pack`
+    dispatch: one fused Pallas pass or the XLA mask/pack/gather chain,
+    depending on `kernels.pallas_mode()`) -> route (dispatch-aware bucket
+    build + all_to_all) -> reduce (owner scatter-add) -> return (shard
+    all_gather + scatter/concat) -> ef.  Mirrors
+    ops/wire_sharded.sharded_combine — update both together.  On one device the collectives are self-copies, so the route/
     return rungs price the bucketisation and reduction machinery, not link
     time — the same caveat as the base ladder's all_gather rungs."""
     from tpu_compressed_dp.ops import wire_sharded
@@ -197,13 +199,10 @@ def _sharded_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
         out = out + t
         if upto == "threshold":
             return out
-        idx = wire.packed_indices_from_mask(mag >= t, keep)
-        out = out + jnp.sum(idx[:8].astype(jnp.float32))
-        if upto == "pack":
-            return out
-        vals = wire._sorted_gather(flat, idx)
-        out = out + jnp.sum(vals[:8])
-        if upto == "gather":
+        vals, idx, _cnt = wire._select_pack(flat, mag, t, keep)
+        out = (out + jnp.sum(idx[:8].astype(jnp.float32))
+               + jnp.sum(vals[:8]))
+        if upto == "select_pack":
             return out
         world = jax.lax.psum(1, axis_name)
         plan = wire_sharded.make_shard_plan(
@@ -211,11 +210,16 @@ def _sharded_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
         W, cap, shard_n = plan.world, plan.cap_dest, plan.shard_n
         slot, accepted, dest = wire_sharded._per_dest_slots(idx, None, plan)
         local = (idx - dest * shard_n).astype(jnp.int32)
-        bvals = jnp.zeros((W * cap + 1,), flat.dtype).at[slot].add(vals)[:-1]
-        bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
-                        ).at[slot].set(local)[:-1]
-        rvals = jax.lax.all_to_all(bvals.reshape(W, cap), axis_name, 0, 0)
-        ridx = jax.lax.all_to_all(bidx.reshape(W, cap), axis_name, 0, 0)
+        if kernels.use_bucket_route(idx.shape[0], W, cap):
+            bvals, bidx = kernels.fused_bucket_route(
+                vals, idx, dest, W, cap, shard_n)
+        else:
+            bvals = jnp.zeros((W * cap + 1,), flat.dtype
+                              ).at[slot].add(vals)[:-1].reshape(W, cap)
+            bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
+                            ).at[slot].set(local)[:-1].reshape(W, cap)
+        rvals = jax.lax.all_to_all(bvals, axis_name, 0, 0)
+        ridx = jax.lax.all_to_all(bidx, axis_name, 0, 0)
         out = out + jnp.sum(rvals[0, :8])
         if upto == "route":
             return out
@@ -264,7 +268,8 @@ def _sharded_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
 
 def _hier_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
     """Stage ladder for the HIERARCHICAL transport (transport=
-    'hierarchical'): mag -> threshold -> pack (select + scatter the dense
+    'hierarchical'): mag -> threshold -> pack (the shipped
+    `wire._select_pack` dispatch + scatter the dense
     contribution) -> ici_reduce (intra-pod dense psum) -> recompress (pod
     union pack + per-chip slab slice) -> dcn_route (the grouped owner-
     sharded exchange across pods) -> return (the second intra-pod psum
@@ -283,8 +288,7 @@ def _hier_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
         out = out + t
         if upto == "threshold":
             return out
-        idx = wire.packed_indices_from_mask(mag >= t, keep)
-        vals = wire._sorted_gather(flat, idx)
+        vals, idx, _cnt = wire._select_pack(flat, mag, t, keep)
         contrib = jnp.zeros((n,), flat.dtype).at[idx].set(
             vals, indices_are_sorted=True, unique_indices=True,
             mode="promise_in_bounds")
@@ -343,8 +347,49 @@ def _hier_chain(upto: str, n: int, keep: int, cfg, axis_name: str = "data"):
     return chain
 
 
+def _dispatch_chain(upto: str, n: int, keep: int, axis_name: str = "data"):
+    """Ladder over the SHIPPED select+pack dispatch (`wire._select_pack`):
+    one rung covers select+pack+gather, because that is exactly what the
+    fused kernel collapses.  Under ``pallas off`` the rung lowers to the
+    XLA mask -> `packed_indices_from_mask` -> `_sorted_gather` chain; under
+    auto/force it is one `kernels.fused_select_pack` call — so timing the
+    SAME ladder under both modes prices the toggle on identical stage
+    boundaries (the `--compare` table)."""
+
+    def chain(flat: jax.Array):
+        mag = jnp.abs(flat).astype(jnp.float32)
+        out = jnp.sum(mag[:8])
+        if upto == "mag":
+            return out
+        t = kernels.topk_threshold(mag, keep)
+        out = out + t
+        if upto == "threshold":
+            return out
+        vals, idx, count = wire._select_pack(flat, mag, t, keep)
+        out = (out + jnp.sum(vals[:8])
+               + jnp.sum(idx[:8].astype(jnp.float32))
+               + count.astype(jnp.float32))
+        if upto == "select_pack":
+            return out
+        world = jax.lax.psum(1, axis_name)
+        g_vals = wire._all_gather(vals, axis_name)
+        g_idx = wire._all_gather(idx, axis_name)
+        dense = wire._scatter_combine(flat.shape, flat.dtype, g_idx, g_vals,
+                                      world)
+        out = out + jnp.sum(dense[:8])
+        if upto == "combine":
+            return out
+        new_ef = flat.at[idx].set(0, indices_are_sorted=True,
+                                  unique_indices=True,
+                                  mode="promise_in_bounds")
+        return out + jnp.sum(new_ef[:8])
+
+    return chain
+
+
 STAGES = ["mag", "threshold", "pack", "gather", "combine", "ef"]
-SHARDED_STAGES = ["mag", "threshold", "pack", "gather", "route", "reduce",
+DISPATCH_STAGES = ["mag", "threshold", "select_pack", "combine", "ef"]
+SHARDED_STAGES = ["mag", "threshold", "select_pack", "route", "reduce",
                   "return", "ef"]
 HIER_STAGES = ["mag", "threshold", "pack", "ici_reduce", "recompress",
                "dcn_route", "return", "ef"]
@@ -373,6 +418,16 @@ def main(argv=None):
                     help="also profile packed_indices_from_mask sub-stages")
     ap.add_argument("--pack2", action="store_true",
                     help="run the (negative-result) full-scatter formulation")
+    ap.add_argument("--compare", action="store_true",
+                    help="price the fused-kernel toggle: time the shipped "
+                         "_select_pack ladder under pallas off AND "
+                         "--pallas_mode, print XLA vs Pallas columns per "
+                         "stage (intended home: the TPU chip — forcing "
+                         "off-TPU runs kernels interpreted, which is a "
+                         "correctness rehearsal, not a timing)")
+    ap.add_argument("--pallas_mode", default="force",
+                    choices=["auto", "force"],
+                    help="the non-off column of --compare")
     ap.add_argument("--transport", default="allgather",
                     choices=["allgather", "sharded", "hierarchical"],
                     help="profile the flat all_gather combine, the "
@@ -451,6 +506,35 @@ def main(argv=None):
         dt = time_fn(fn, x, args.iters)
         print(f"pack2-scatter-formulation full chain {dt*1e3:8.2f} ms "
               f"(vs ladder total {total:.2f} ms)")
+    if args.compare:
+        # same ladder, two dispatch modes: re-jit per mode because the
+        # pallas decision is made at trace time inside _select_pack
+        cols = {}
+        prev_mode = kernels.pallas_mode()
+        try:
+            for mode in ("off", args.pallas_mode):
+                kernels.set_pallas_mode(mode)
+                cum = []
+                for st in DISPATCH_STAGES:
+                    fn = jax.jit(shard_map(_dispatch_chain(st, n, keep),
+                                           mesh=mesh, in_specs=P(),
+                                           out_specs=P()))
+                    cum.append(time_fn(fn, x, args.iters) * 1e3)
+                cols[mode] = cum
+        finally:
+            kernels.set_pallas_mode(prev_mode)
+        xla, pal = cols["off"], cols[args.pallas_mode]
+        print(f"# pallas compare [_select_pack ladder]: per-stage ms, "
+              f"pallas=off vs pallas={args.pallas_mode}")
+        print(f"{'stage':12s} {'xla_ms':>9s} {'pallas_ms':>9s} "
+              f"{'delta_ms':>9s}")
+        px = pp = 0.0
+        for st, cx, cp in zip(DISPATCH_STAGES, xla, pal):
+            sx, sp = max(cx - px, 0.0), max(cp - pp, 0.0)
+            print(f"{st:12s} {sx:9.2f} {sp:9.2f} {sp - sx:+9.2f}")
+            px, pp = cx, cp
+        print(f"{'total':12s} {xla[-1]:9.2f} {pal[-1]:9.2f} "
+              f"{pal[-1] - xla[-1]:+9.2f}")
     return rows
 
 

@@ -38,6 +38,8 @@ CODES: Dict[str, str] = {
     "TCDP004": "overlap chunk plan or optimization_barrier chain broken "
                "(duplicate group offsets, non-partitioning chunks, "
                "unchained chunk collectives)",
+    "TCDP005": "traced config exceeds its jaxpr equation budget — a "
+               "leaf/chunk/device loop is unrolling into the trace",
     # pass 2 — host-side AST linter (analysis/hostlint.py)
     "TCDP100": "tcdp-lint disable comment without '-- <justification>'",
     "TCDP101": "wall-clock read (time.time / datetime.now) in a "

@@ -30,6 +30,19 @@ and all three step factories:
     and the traced chunked sync must carry ``optimization_barrier`` links
     with a collective ancestor between consecutive chunks — the
     issue-order invariant PR 5's schedule evidence relies on.
+  * **TCDP005 — per-config jaxpr size budget.**  Every traced config must
+    stay under a fixed equation budget (~3x the measured quick-matrix
+    maximum).  The failure this catches is *accidental unrolling*: a
+    Python loop over leaves, chunks or devices that should be a
+    ``scan``/``fori_loop`` multiplies the trace ~10x, blowing compile
+    time and (on the fused-kernel paths) emitting one Pallas call per
+    iteration instead of one per payload.
+
+The fused compressor kernels (``ops/kernels.py``) add one more axis to
+the matrix: representative fused-path configs are traced under
+``pallas_mode`` off AND force, and the ordered collective signature must
+be identical between the two — the kernel family is pure local compute,
+so toggling it may never add, drop or reorder a collective.
 
 Everything here is pure tracing (``jax.make_jaxpr`` / ``jax.eval_shape``)
 — no compilation, no devices beyond the virtual CPU mesh — so the full
@@ -49,7 +62,9 @@ from tpu_compressed_dp.analysis.report import Finding
 __all__ = [
     "COLLECTIVE_PRIMS", "collective_signature", "check_control_flow",
     "check_signature_match", "check_donation", "check_chunk_plan",
-    "check_barrier_chain", "trace_sync", "run_spmd_pass", "ENGINE_METHODS",
+    "check_barrier_chain", "count_eqns", "check_jaxpr_budget",
+    "EQN_BUDGET_SYNC", "EQN_BUDGET_STEP", "trace_sync", "run_spmd_pass",
+    "ENGINE_METHODS",
 ]
 
 #: primitives that hit the interconnect — any of these inside divergent
@@ -374,6 +389,44 @@ def check_barrier_chain(jaxpr, *, n_chunks: int, config: str = ""
     return []
 
 
+#: TCDP005 budgets — measured 2026-08 quick-matrix maxima (~500 eqns for a
+#: sync trace, ~1530 for the LM step) with ~3x headroom.  An unrolled
+#: 11-leaf loop multiplies a trace ~10x, so it trips the budget long
+#: before trace time becomes painful.  Default-mode traces only: force
+#: mode off-TPU runs kernels under the Pallas interpreter, which inlines
+#: kernel bodies into the jaxpr and is not what ships.
+EQN_BUDGET_SYNC = 1500
+EQN_BUDGET_STEP = 4500
+
+
+def count_eqns(jaxpr) -> int:
+    """Total equation count of a (Closed)Jaxpr, recursing into every
+    sub-jaxpr — the size measure TCDP005 budgets.  Loop bodies count ONCE
+    (a ``scan`` over K chunks adds its body once), which is exactly why
+    the budget separates rolled from unrolled programs."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += 1
+        for sub in _sub_jaxprs(eqn):
+            n += count_eqns(sub)
+    return n
+
+
+def check_jaxpr_budget(jaxpr, *, budget: int, config: str = ""
+                       ) -> List[Finding]:
+    """TCDP005: one traced config must fit its equation budget."""
+    n = count_eqns(jaxpr)
+    if n > budget:
+        return [Finding(
+            code="TCDP005", config=config,
+            message=f"traced jaxpr holds {n} equations, budget {budget} — "
+                    "a Python loop over leaves/chunks/devices is probably "
+                    "unrolling into the trace (use scan/fori_loop, or raise "
+                    "the budget with a justification if growth is real)")]
+    return []
+
+
 # -------------------------------------------------------- tracing the tree
 
 def _mesh(n: int):
@@ -480,6 +533,8 @@ def _check_engines(profile: str, mesh) -> Tuple[List[Finding], int]:
         closed2, _, _, _ = trace_sync(cfg, mesh)
         n += 2
         findings += check_control_flow(closed, config=label)
+        findings += check_jaxpr_budget(closed, budget=EQN_BUDGET_SYNC,
+                                       config=label)
         sig = collective_signature(closed)
         findings += check_signature_match(
             sig, collective_signature(closed2), "trace#1", "trace#2",
@@ -504,6 +559,8 @@ def _check_engines(profile: str, mesh) -> Tuple[List[Finding], int]:
             dataclasses.replace(cfg, sync_overlap=1), mesh)
         n += 2
         findings += check_control_flow(chunked, config=label)
+        findings += check_jaxpr_budget(chunked, budget=EQN_BUDGET_SYNC,
+                                       config=label)
         findings += check_chunk_plan(plans, n_leaves=n_leaves,
                                      n_groups=n_groups, config=label)
         findings += check_signature_match(
@@ -511,6 +568,68 @@ def _check_engines(profile: str, mesh) -> Tuple[List[Finding], int]:
             "chunked", "single-dispatch", config=label, ordered=False)
         findings += check_barrier_chain(chunked, n_chunks=len(plans),
                                         config=label)
+    findings_p, n_p = _check_pallas_variants(profile, mesh)
+    return findings + findings_p, n + n_p
+
+
+def _pallas_variant_configs(profile: str):
+    """Fused-kernel representatives: one per kernel family (select+pack on
+    allgather, bucket-route on sharded/hierarchical, quantize+pack for
+    terngrad/qsgd) — the paths where ``pallas_mode`` changes the emitted
+    step program."""
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+
+    def mk(m, transport, **kw):
+        if transport == "hierarchical":
+            kw.setdefault("dp_pods", 2)
+        return CompressionConfig(method=m, granularity="entiremodel",
+                                 mode="wire", transport=transport,
+                                 ratio=0.25,
+                                 error_feedback=m not in ("terngrad", "qsgd"),
+                                 check_sync=True, **kw)
+
+    # quick: one select+pack path and one quantize+pack path (the force
+    # traces inline interpreted kernel bodies, so each pair costs ~1 s —
+    # the quick gate rides tier-1's wall budget); full: every family x
+    # transport representative
+    cfgs = [mk("topk", "allgather"), mk("terngrad", "allgather")]
+    if profile == "full":
+        cfgs += [mk("topk", "sharded"), mk("qsgd", "allgather"),
+                 mk("topk", "hierarchical"), mk("blocktopk", "sharded"),
+                 mk("thresholdv", "hierarchical"),
+                 mk("adaptive_threshold", "allgather")]
+    return cfgs
+
+
+def _check_pallas_variants(profile: str, mesh) -> Tuple[List[Finding], int]:
+    """TCDP002 across the ``pallas_mode`` toggle: the fused kernels are
+    pure local compute, so forcing them on (or off) may never add, drop
+    or reorder a collective relative to the XLA fallback chain.  Traced
+    only — ``make_jaxpr`` abstract-evals the pallas_call, so this pins the
+    TPU dispatch shape from the CPU lint pass."""
+    from tpu_compressed_dp.ops import kernels
+
+    findings: List[Finding] = []
+    n = 0
+    for cfg in _pallas_variant_configs(profile):
+        label = _cfg_label(cfg, suffix="/pallas")
+        prev = kernels.pallas_mode()
+        try:
+            kernels.set_pallas_mode("off")
+            off_closed, _, _, _ = trace_sync(cfg, mesh)
+            kernels.set_pallas_mode("force")
+            on_closed, _, _, _ = trace_sync(cfg, mesh)
+        finally:
+            kernels.set_pallas_mode(prev)
+        n += 2
+        findings += check_control_flow(on_closed, config=label)
+        findings += check_signature_match(
+            collective_signature(off_closed), collective_signature(on_closed),
+            "pallas=off", "pallas=force", config=label)
+        # budget the off trace only: force off-TPU interprets, inlining
+        # kernel bodies the shipped program never holds
+        findings += check_jaxpr_budget(off_closed, budget=EQN_BUDGET_SYNC,
+                                       config=label)
     return findings, n
 
 
@@ -572,6 +691,8 @@ def _check_train_step(profile: str) -> Tuple[List[Finding], int]:
         closed = jax.make_jaxpr(step)(state, batch)
         n += 1
         findings += check_control_flow(closed, config=label)
+        findings += check_jaxpr_budget(closed, budget=EQN_BUDGET_STEP,
+                                       config=label)
         findings += check_donation(step, (state, batch), (0,), config=label)
         if profile == "full":
             closed2 = jax.make_jaxpr(step)(state, batch)
@@ -618,6 +739,8 @@ def _check_train_step(profile: str) -> Tuple[List[Finding], int]:
         closed = jax.make_jaxpr(step)(state, batch)
         n += 1
         findings += check_control_flow(closed, config=label)
+        findings += check_jaxpr_budget(closed, budget=EQN_BUDGET_STEP,
+                                       config=label)
         findings += check_donation(step, (state, batch), (0,), config=label)
         rung_sigs[rung] = collective_signature(closed)
     findings += check_signature_match(
@@ -654,6 +777,8 @@ def _check_lm_step(profile: str) -> Tuple[List[Finding], int]:
     label = "lm_step/topk/entiremodel/ef=1"
     closed = jax.make_jaxpr(step)(state, batch)
     findings = check_control_flow(closed, config=label)
+    findings += check_jaxpr_budget(closed, budget=EQN_BUDGET_STEP,
+                                   config=label)
     findings += check_donation(step, (state, batch), (0,), config=label)
     n = 1
     if profile == "full":
@@ -694,6 +819,8 @@ def _check_pp_step(profile: str) -> Tuple[List[Finding], int]:
     label = "pp_step/topk/entiremodel/ef=1"
     closed = jax.make_jaxpr(step)(state, batch)
     findings = check_control_flow(closed, config=label)
+    findings += check_jaxpr_budget(closed, budget=EQN_BUDGET_STEP,
+                                   config=label)
     findings += check_donation(step, (state, batch), (0,), config=label)
     n = 1
     if profile == "full":

@@ -277,6 +277,24 @@ def terngrad_num_chunks(n: int, chunk: int) -> int:
     return -(-n // chunk)
 
 
+def terngrad_prescale(g: Array, chunk: int) -> tuple[Array, Array]:
+    """Chunked TernGrad prescale: divide each ``chunk``-sized slice by its
+    own ``max|g|`` so the quantiser sees a unit-scale vector
+    (``|scaled| <= 1``).  Returns ``(scaled f32[n], gmax f32[num_chunks])``.
+    Factored out of :func:`terngrad_levels` so the fused quantize+pack
+    kernel path (:func:`tpu_compressed_dp.ops.kernels.terngrad_pack_prescaled`)
+    can consume the prescaled vector without round-tripping int8 levels."""
+    g = _flat(g)
+    n = g.shape[0]
+    nc = terngrad_num_chunks(n, chunk)
+    pad = nc * chunk - n
+    g2 = jnp.pad(g.astype(jnp.float32), (0, pad)).reshape(nc, chunk)
+    gmax = jnp.max(jnp.abs(g2), axis=1)                      # [nc]
+    inv = jnp.where(gmax > 0, 1.0 / jnp.where(gmax > 0, gmax, 1.0), 0.0)
+    scaled = (g2 * inv[:, None]).reshape(-1)[:n]             # |scaled| <= 1
+    return scaled, gmax
+
+
 def terngrad_levels(g: Array, key: Array, *, chunk: int = 0) -> tuple[Array, Array]:
     """TernGrad's integer representation: ``(levels int8 in {-1,0,1}, scale)``.
 
@@ -310,12 +328,7 @@ def terngrad_levels(g: Array, key: Array, *, chunk: int = 0) -> tuple[Array, Arr
     # chunked: normalise each chunk by its own max, then ternarise the
     # prescaled vector with unit scale (one extra elementwise pass; the
     # quantisation pass itself is unchanged)
-    nc = terngrad_num_chunks(n, chunk)
-    pad = nc * chunk - n
-    g2 = jnp.pad(g.astype(jnp.float32), (0, pad)).reshape(nc, chunk)
-    gmax = jnp.max(jnp.abs(g2), axis=1)                      # [nc]
-    inv = jnp.where(gmax > 0, 1.0 / jnp.where(gmax > 0, gmax, 1.0), 0.0)
-    scaled = (g2 * inv[:, None]).reshape(-1)[:n]             # |scaled| <= 1
+    scaled, gmax = terngrad_prescale(g, chunk)
     if kernels.use_quant_kernels(n):
         levels = kernels.terngrad_quantize_prescaled(scaled, key)
     else:

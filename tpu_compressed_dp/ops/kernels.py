@@ -22,10 +22,28 @@ that map badly onto stock XLA at gradient scale (SURVEY.md §7 "hard parts"):
     (``pltpu.prng_random_bits``), dithers, and emits packed integer levels
     (int16 / int8), instead of XLA materialising a full fp32 uniform tensor.
     The integer levels are exactly what the wire path transmits.
+  * **Fused select+pack** (``fused_select_pack``) — one pass from the
+    histogram threshold to the compacted ascending ``(value, index)`` wire
+    payload (per-segment shift-network compaction + an nseg-sized rank
+    bucketing epilogue), replacing the wire path's dense mask ->
+    `packed_indices_from_mask` -> `_sorted_gather` chain.  Bitwise-parity
+    with the XLA chain, gated in tier-1 under the interpreter.
+  * **Fused quantize+pack** (``terngrad_pack`` / ``qsgd_pack``) — dither
+    AND bit-pack in the same pass: 2-bit TernGrad codes or QSGD uint8
+    magnitudes + sign bitmap come out as wire bytes directly (matmul-based
+    lane packing; the byte layout is bitwise `wire.pack_ternary` /
+    `wire.pack_bits`).
+  * **Fused bucket route** (``fused_bucket_route``) — the sharded
+    transport's per-destination fixed-capacity bucket build as W windowed
+    DMA copies instead of a [W*cap+1] scatter pair, preserving the
+    monotone-row invariant the owner-side sorted-scatter hints rely on.
 
 Dispatch: ``auto`` (default) uses the kernels on TPU backends for tensors
 of at least ``MIN_PALLAS_ELEMS`` elements and falls back to pure JAX
-elsewhere; ``off`` / ``force`` override (``force`` is CI-on-TPU only).  The
+elsewhere; ``off`` / ``force`` override.  Off-TPU, ``force`` runs the
+non-PRNG kernels under the Pallas interpreter — slow, but it executes the
+fused dispatch call sites end to end in CPU CI (PRNG kernels additionally
+need the TPU-semantics interpreter, `compat.HAS_TPU_INTERPRET`).  The
 quantizer kernels draw from the TPU hardware PRNG, a *different stream* than
 ``jax.random`` — same distribution, so estimators stay unbiased, but
 bitwise results differ from the pure path (the dispatch seed is derived from
@@ -62,6 +80,16 @@ __all__ = [
     "seg_pack_by_threshold",
     "seg_pack_payload",
     "use_seg_pack",
+    "fused_select_pack",
+    "use_select_pack",
+    "pack_ternary_pallas",
+    "qsgd_pack_pallas",
+    "terngrad_pack",
+    "terngrad_pack_prescaled",
+    "qsgd_pack",
+    "use_quant_pack",
+    "fused_bucket_route",
+    "use_bucket_route",
     "qsgd_quantize",
     "terngrad_quantize",
     "terngrad_quantize_prescaled",
@@ -93,8 +121,27 @@ def _dispatch_to_pallas(n: int) -> bool:
     return jax.default_backend() == "tpu" and n >= MIN_PALLAS_ELEMS
 
 
+def _auto_interpret() -> bool:
+    """``force`` off-TPU runs the kernels under the Pallas interpreter, so
+    the fused dispatch *paths* (wire/sharded call sites included) execute end
+    to end in CPU CI instead of dying in Mosaic lowering.  PRNG kernels stay
+    on the TPU-semantics interpreter gate (`compat.HAS_TPU_INTERPRET`) — the
+    stock HLO interpreter's PRNG is a zero stub."""
+    return _MODE == "force" and jax.default_backend() != "tpu"
+
+
 def _pad_chunks(flat: Array, fill: float, rows: int = _ROWS) -> Tuple[Array, int]:
-    """Pad a flat vector to whole (rows, 128) chunks, reshaped 2D."""
+    """Pad a flat vector to whole (rows, 128) chunks, reshaped 2D.
+
+    Fill discipline (audited): padding lanes must be invisible to every
+    consumer even when the DATA is poisoned (NaN/Inf guard-vetoed steps).
+    The histogram kernels use ``fill=-1.0`` — strictly below every bin edge
+    (edges are ``>= lo >= 0`` and stay finite via the non-finite ``hi``
+    clamp in the threshold paths) — while the pack/quantize/select kernels
+    use ``fill=0`` and mask by global position (``pos < n``) instead, which
+    holds for any fill.  New kernels must pick one of those two disciplines;
+    a fill that merely compares below *typical* data is not enough.
+    """
     n = flat.shape[0]
     chunk = rows * _LANES
     padded_n = -(-n // chunk) * chunk
@@ -245,9 +292,18 @@ def _topk_threshold_pallas(
             compat.pcast(v, vma, to="varying") if not _vma(v) else v for v in vals
         )
 
-    # max|g| strictly below hi so the top element always lands in a bin
+    # max|g| strictly below hi so the top element always lands in a bin.
+    # A non-finite max (guard-vetoed NaN/Inf gradient, or fp32 overflow of
+    # the eps bump) would poison every bin edge — counts degenerate and the
+    # refinement collapses to t = 0, selecting *everything*.  Clamping hi to
+    # FP32_MAX keeps the histogram ranking the finite magnitudes: padding
+    # lanes (fill -1.0, strictly below every edge >= lo >= 0) still never
+    # count, NaNs compare-false out of every bin, and +-Inf sits above every
+    # edge exactly like the true max used to.
+    hi_raw = jnp.max(mag).astype(jnp.float32) * 1.0000002 + 1e-30
     full_init = pcast(
-        (jnp.float32(0.0), (jnp.max(mag) * 1.0000002 + 1e-30).astype(jnp.float32),
+        (jnp.float32(0.0),
+         jnp.where(jnp.isfinite(hi_raw), hi_raw, jnp.float32(3.4028235e38)),
          jnp.float32(0.0)))
 
     if not sample_init or keep < 1 or n < (1 << 18):
@@ -293,7 +349,12 @@ def _topk_threshold_pallas(
     sv = jax.lax.top_k(sample, hi_rank + 1)[0]
     # 15 interior quantile edges spanning [rank r+delta, rank r-delta],
     # ascending in value (17 edges = 16 bins with the 0 and max*(1+eps)
-    # brackets); duplicate edges (sample ties) just yield empty bins
+    # brackets); duplicate edges (sample ties) just yield empty bins.
+    # A NaN slab sample (guard-vetoed gradient) poisons its top_k quantiles
+    # — a NaN edge survives jnp.minimum, zeroes that bin's count, and the
+    # bin selection then violates the count >= keep guarantee (underfull
+    # pack -> duplicate-index payload).  Clamp non-finite edges to the hi
+    # bracket: an empty top bin, exactly like a duplicate edge.
     qranks = [int(round(lo_rank + (hi_rank - lo_rank) * i / 14.0))
               for i in range(15)]
     interior = [sv[rk] for rk in reversed(qranks)]           # ascending
@@ -301,7 +362,8 @@ def _topk_threshold_pallas(
     edges = jnp.stack(
         [jnp.float32(0.0) if not _vma(mag)
          else compat.pcast(jnp.float32(0.0), tuple(_vma(mag)), to="varying")]
-        + [jnp.minimum(e, hi0) for e in interior] + [hi0]
+        + [jnp.where(jnp.isfinite(e), jnp.minimum(e, hi0), hi0)
+           for e in interior] + [hi0]
     )
 
     count_edges = pl.pallas_call(
@@ -360,7 +422,10 @@ def _topk_threshold_jnp(mag: Array, keep: int, rounds: int = 7) -> Array:
     margin = 8.0 * n / float(1 << 23) if n > (1 << 23) else 0.0
     keep_f = jnp.float32(min(keep + margin, n))
     lo = jnp.float32(0.0)
-    hi = (jnp.max(mag) * 1.0000002 + 1e-30).astype(jnp.float32)
+    # same non-finite clamp as the kernel path: a NaN/Inf max must not
+    # poison the bin edges (see _topk_threshold_pallas)
+    hi_raw = (jnp.max(mag) * 1.0000002 + 1e-30).astype(jnp.float32)
+    hi = jnp.where(jnp.isfinite(hi_raw), hi_raw, jnp.float32(3.4028235e38))
     above = jnp.float32(0.0)
     for _ in range(rounds):
         width = (hi - lo) / _HIST_BINS
@@ -400,10 +465,18 @@ def topk_threshold(mag: Array, keep: int) -> Array:
         # fp32 always: downcasting the bin edge to a lower-precision input
         # dtype could round UP past the true k-th magnitude and break the
         # count(mag >= t) >= keep guarantee
-        return _topk_threshold_pallas(mag, keep)
+        return _topk_threshold_pallas(mag, keep, interpret=_auto_interpret())
     if n > _INT32_MAX:
         return _topk_threshold_jnp(mag, keep)
-    return jax.lax.top_k(mag.astype(jnp.float32), keep)[0][-1]
+    # NaN sorts as LARGEST under lax.top_k: each guard-vetoed NaN would
+    # steal a top-k slot, land the threshold one rank too high, and
+    # underfill the pack (duplicate-index payload, voided scatter hints —
+    # the poisoned-tail leak).  Demote NaN below every magnitude so the
+    # threshold ranks the finite values; NaN still never travels (it
+    # compares false against any threshold).
+    m32 = mag.astype(jnp.float32)
+    m32 = jnp.where(jnp.isnan(m32), -1.0, m32)
+    return jax.lax.top_k(m32, keep)[0][-1]
 
 
 # ---------------------------------------------------------------------------
@@ -456,11 +529,13 @@ _SPARSIFY_ROWS = 512
 
 
 def fused_sparsify(acc: Array, t: Array, *, want_ef: bool = True,
-                   interpret: bool = False):
+                   interpret: bool | None = None):
     """``(comp, new_ef | None, count)`` keeping coordinates ``|acc| >= t`` —
     the simulate-mode epilogue fused into one pass over the (already
     EF-accumulated) gradient.  fp32 in/out: the caller gates dispatch on
     fp32 inputs so the psum payload dtype matches the unfused path."""
+    if interpret is None:
+        interpret = _auto_interpret()
     n = acc.shape[0]
     rows = _SPARSIFY_ROWS
     x2d, num_chunks = _pad_chunks(acc.astype(jnp.float32), fill=0.0, rows=rows)
@@ -975,6 +1050,175 @@ def use_seg_pack(n: int, keep: int) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Fused select+pack (wire-mode Top-K: one-pass threshold select -> payload)
+# ---------------------------------------------------------------------------
+#
+# The r4 seg-pack postmortem identified the per-segment CAP as the killer
+# (concentrated LM gradients overflow 128 slots/4096 elements and drop sent
+# mass), not the shift network itself.  This kernel removes the cap: each
+# 4096-element segment is FULLY left-compacted (capacity = segment size, so
+# no survivor is ever clipped), staging compacted (value, global-index)
+# pairs plus a per-segment survivor count in ONE pass over the gradient.  A
+# small XLA epilogue (cumsum over nseg counts + one rank-bucketed gather of
+# exactly `keep` slots) then assembles the wire payload — the
+# `packed_indices_from_mask` trick at segment granularity, ~32x fewer
+# buckets than the per-128-lane-row XLA chain, and without the chain's
+# full-width mask materialisation, row-count pass, and element gather over n.
+# Within-segment compaction preserves ascending order and segments are
+# ascending, so the payload is bitwise identical to the unfused
+# mask -> packed_indices_from_mask -> _sorted_gather pipeline (parity-gated
+# in tier-1 under the interpreter).
+
+
+def _select_pack_kernel(n: int, t_ref, x_ref, vals_ref, idx_ref, cnt_ref):
+    rows = x_ref.shape[0]                        # _SEG_PER_BLOCK * _SEG_ROWS
+    x = x_ref[:]
+    base = pl.program_id(0) * rows * _LANES
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0)
+    gpos = base + row * _LANES + lane
+    seg_row = row % _SEG_ROWS
+    spos = seg_row * _LANES + lane
+    # fp32 magnitude compare regardless of input dtype — matches the wire
+    # path's `jnp.abs(flat).astype(f32) >= t` bit for bit (abs is exact, and
+    # upcast-then-compare equals compare-after-promotion for bf16 inputs)
+    m = jnp.logical_and(jnp.abs(x.astype(jnp.float32)) >= t_ref[0, 0],
+                        gpos < n)
+
+    # in-segment 1-based survivor rank: same tri-matmul in-row prefix +
+    # Hillis-Steele row scan as _seg_pack_kernel
+    mf = m.astype(jnp.float32)
+    tri = (jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 0)
+           <= jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 1)
+           ).astype(jnp.float32)
+    inrow = jax.lax.dot_general(mf, tri, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    rowcnt = jnp.broadcast_to(inrow[:, _LANES - 1:], (rows, _LANES))
+    rowpfx = rowcnt
+    s = 1
+    while s < _SEG_ROWS:
+        shifted = jnp.roll(rowpfx, s, axis=0)
+        rowpfx = jnp.where(seg_row >= s, rowpfx + shifted, rowpfx)
+        s *= 2
+    rank = (rowpfx - rowcnt + inrow).astype(jnp.int32)   # 1-based, survivors
+
+    # route EVERY survivor left by d = spos - (rank-1); no eligibility cap,
+    # so distances stay monotone non-decreasing in position and the LSB->MSB
+    # schedule stays collision-free for the full log2(_SEG) rounds
+    d = jnp.where(m, spos - (rank - 1), 0)
+    vals = x
+    gidx = gpos
+    b = 0
+    while (1 << b) < _SEG:
+        sft = 1 << b
+        rd = _roll_flat(d, sft, _SEG_ROWS)
+        rv = _roll_flat(vals, sft, _SEG_ROWS)
+        ri = _roll_flat(gidx, sft, _SEG_ROWS)
+        move_in = jnp.logical_and(((rd >> b) & 1) == 1, spos < _SEG - sft)
+        my_move = ((d >> b) & 1) == 1
+        vals = jnp.where(move_in, rv, vals)
+        gidx = jnp.where(move_in, ri, gidx)
+        d = jnp.where(move_in, rd - sft, jnp.where(my_move, 0, d))
+        b += 1
+
+    vals_ref[:] = vals
+    idx_ref[:] = gidx
+    # per-segment survivor totals: rowpfx at each segment's last row is the
+    # inclusive count (identical across lanes) — full 128-lane row writes,
+    # the reader takes lane 0
+    r3 = rowpfx.reshape(rows // _SEG_ROWS, _SEG_ROWS, _LANES)
+    cnt_ref[:] = r3[:, _SEG_ROWS - 1, :].astype(jnp.int32)
+
+
+def _select_pack_payload(vals_st: Array, idx_st: Array, counts: Array,
+                         keep: int):
+    """Rank-bucket the per-segment compacted prefixes into the exact
+    ``keep``-slot payload (ascending global index).  Segment-granular
+    `packed_indices_from_mask`: find each payload rank's segment via a
+    histogram over segment end-counts, then one sorted gather from the
+    staging buffer.  Underfull masks (count < keep — only reachable on
+    poisoned gradients; `topk_threshold` guarantees count >= keep otherwise)
+    pad with value 0 / index 0, scatter-add identities."""
+    nseg = counts.shape[0]
+    v = vals_st.reshape(nseg, _SEG)
+    ix = idx_st.reshape(nseg, _SEG)
+    ends = jnp.cumsum(counts)                              # inclusive
+    total = ends[nseg - 1]
+    ranks = jnp.arange(1, keep + 1, dtype=jnp.int32)
+    hist = jnp.zeros((keep + 1,), jnp.int32).at[
+        jnp.minimum(ends, keep)].add(1, indices_are_sorted=True)
+    seg_of = jnp.cumsum(hist)[:keep]
+    valid = seg_of < nseg
+    # clamp to the last segment (not 0) so flat_pos stays monotone and the
+    # gather can keep its sorted hint
+    seg_of = jnp.where(valid, seg_of, nseg - 1)
+    # one gather of precomputed exclusive starts (the packed_indices_from_mask
+    # trick), not two of ends and counts
+    starts = (ends - counts).at[seg_of].get(indices_are_sorted=True,
+                                            mode="promise_in_bounds")
+    within = jnp.clip(ranks - starts - 1, 0, _SEG - 1)
+    flat_pos = seg_of * _SEG + within
+    gv = v.reshape(-1).at[flat_pos].get(indices_are_sorted=True,
+                                        mode="promise_in_bounds")
+    gi = ix.reshape(-1).at[flat_pos].get(indices_are_sorted=True,
+                                         mode="promise_in_bounds")
+    pvals = jnp.where(valid, gv, jnp.zeros((), vals_st.dtype))
+    pidx = jnp.where(valid, gi, 0)
+    return pvals, pidx, total
+
+
+def fused_select_pack(flat: Array, t: Array, keep: int, *,
+                      interpret: bool | None = None):
+    """``(vals [keep], idx [keep], count)``: the wire-mode Top-K payload —
+    coordinates with ``|flat| >= t`` by ascending index, their values in
+    ``flat.dtype`` — in one Pallas pass plus an nseg-sized epilogue.
+
+    Bitwise-identical to ``mask -> packed_indices_from_mask -> _sorted_gather``
+    whenever the `topk_threshold` contract ``count(|flat| >= t) >= keep``
+    holds (the one difference is deliberate: an underfull mask pads value 0 /
+    index 0 instead of replicating ``flat[0]``).  ``count`` is the total
+    survivor count (int32) for surplus accounting.
+    """
+    n = flat.shape[0]
+    if n > _INT32_MAX:
+        raise ValueError(f"fused_select_pack indexes int32; got n={n}")
+    if interpret is None:
+        interpret = _auto_interpret()
+    rows_blk = _SEG_PER_BLOCK * _SEG_ROWS
+    x2d, num_blocks = _pad_chunks(flat, fill=0.0, rows=rows_blk)
+    nseg = x2d.shape[0] // _SEG_ROWS
+    vma = _vma(flat)
+    blk = pl.BlockSpec((rows_blk, _LANES), lambda i: (i, 0),
+                       memory_space=pltpu.VMEM)
+    seg_out = pl.BlockSpec((_SEG_PER_BLOCK, _LANES), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        functools.partial(_select_pack_kernel, n),
+        grid=(num_blocks,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            blk,
+        ],
+        out_specs=[blk, blk, seg_out],
+        out_shape=[
+            compat.shape_dtype_struct(x2d.shape, flat.dtype, vma=vma),
+            compat.shape_dtype_struct(x2d.shape, jnp.int32, vma=vma),
+            compat.shape_dtype_struct((nseg, _LANES), jnp.int32, vma=vma),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(t).reshape(1, 1).astype(jnp.float32), x2d)
+    return _select_pack_payload(outs[0], outs[1], outs[2][:, 0], int(keep))
+
+
+def use_select_pack(n: int, keep: int) -> bool:
+    """Whether the wire Top-K select+pack should take the fused kernel.
+    Unlike the capped seg-pack (measured tie, off), full per-segment
+    compaction has no overflow pathology, so it dispatches on the standard
+    gates; the epilogue gather is O(keep)."""
+    return _dispatch_to_pallas(n) and n <= _INT32_MAX and keep >= 1
+
+
+# ---------------------------------------------------------------------------
 # Fused stochastic quantisation
 # ---------------------------------------------------------------------------
 
@@ -1041,12 +1285,14 @@ def _seed_from_key(key: Array) -> Array:
 
 
 def qsgd_quantize(flat: Array, key: Array, *, qstates: int = 255,
-                  interpret: bool = False) -> Tuple[Array, Array]:
+                  interpret: bool | None = None) -> Tuple[Array, Array]:
     """Fused QSGD levels: ``(int16 levels in [-s, s], fp32 scale)``.
 
     Same estimator as :func:`compressors.qsgd_levels` (`core.py:207-213`),
     dither drawn from the TPU hardware PRNG seeded off ``key``.
     """
+    if interpret is None:
+        interpret = _auto_interpret() and compat.HAS_TPU_INTERPRET
     norm = jnp.linalg.norm(flat.astype(jnp.float32))
     inv = jnp.where(norm > 0, 1.0 / jnp.where(norm > 0, norm, 1.0), 0.0)
     levels = _run_quant(
@@ -1058,9 +1304,11 @@ def qsgd_quantize(flat: Array, key: Array, *, qstates: int = 255,
 
 
 def terngrad_quantize(flat: Array, key: Array, *,
-                      interpret: bool = False) -> Tuple[Array, Array]:
+                      interpret: bool | None = None) -> Tuple[Array, Array]:
     """Fused TernGrad levels: ``(int8 levels in {-1,0,1}, fp32 scale)``
     (`core.py:200-206`), dither from the TPU hardware PRNG."""
+    if interpret is None:
+        interpret = _auto_interpret() and compat.HAS_TPU_INTERPRET
     gmax = jnp.max(jnp.abs(flat.astype(jnp.float32)))
     inv = jnp.where(gmax > 0, 1.0 / jnp.where(gmax > 0, gmax, 1.0), 0.0)
     levels = _run_quant(
@@ -1070,9 +1318,11 @@ def terngrad_quantize(flat: Array, key: Array, *,
 
 
 def terngrad_quantize_prescaled(scaled: Array, key: Array, *,
-                                interpret: bool = False) -> Array:
+                                interpret: bool | None = None) -> Array:
     """TernGrad levels for an already chunk-normalised input (``|x| <= 1``,
     unit scale) — the chunked-scale path's quantisation pass."""
+    if interpret is None:
+        interpret = _auto_interpret() and compat.HAS_TPU_INTERPRET
     return _run_quant(
         _terngrad_kernel, jnp.int8, scaled,
         jnp.asarray(1.0, jnp.float32), _seed_from_key(key), interpret,
@@ -1080,8 +1330,221 @@ def terngrad_quantize_prescaled(scaled: Array, key: Array, *,
 
 
 def use_quant_kernels(n: int) -> bool:
-    """Whether the fused quantizer kernels should serve this tensor."""
-    return _dispatch_to_pallas(n)
+    """Whether the fused quantizer kernels should serve this tensor.
+
+    Forced off-TPU the PRNG kernels need the TPU-semantics interpreter
+    (the stock HLO interpreter's PRNG is a zero stub) — without it the
+    jnp paths serve instead of crashing the lowering."""
+    if not _dispatch_to_pallas(n):
+        return False
+    return not _auto_interpret() or compat.HAS_TPU_INTERPRET
+
+
+# ---------------------------------------------------------------------------
+# Fused quantize+pack (TernGrad 2-bit / QSGD mag + sign-bitmap wire bytes)
+# ---------------------------------------------------------------------------
+#
+# The quantizer kernels above emit integer LEVELS; XLA then runs
+# `wire.pack_ternary` / `wire.pack_bits` as separate shift/sum passes over
+# the levels before anything hits the wire.  These kernels emit the wire
+# BYTES directly.  Bit-packing on the VPU has no sub-word shuffles: packing
+# is one matmul against a 0/1-weighted selector — codes [R, 128] times
+# packmat [128, 128/g] where column l//g carries weight base^(l%g) — and a
+# row-major reshape of the [R, 128/g] byte panel back to 128-lane rows.
+# Operands are small exact integers (codes <= 2, weights <= 128, bytes <=
+# 255 < 2^24), so even the MXU's bf16 default precision is exact, like the
+# 0/1 count matmuls in the pack kernels.  Byte order matches the XLA
+# packers bitwise: byte j of the flat output packs elements g*j .. g*j+g-1
+# little-endian, which is exactly row-major order of the reshaped panel.
+
+# 256-row element blocks: ternary bytes come out [64, 128] and sign-bitmap
+# bytes [32, 128] — both at or above the uint8 (32, 128) min tile
+_QPACK_ROWS = 256
+
+
+def _bytepack(v: Array, g: int) -> Array:
+    """[R, 128] f32 small-int codes -> [R * 128 // (g * 128), 128] f32 bytes
+    packing ``g`` consecutive lanes per byte, little-endian (weight
+    ``(2^(8/g))^(l%g)`` at column ``l//g``)."""
+    rows = v.shape[0]
+    cols = _LANES // g
+    li = jax.lax.broadcasted_iota(jnp.int32, (_LANES, cols), 0)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (_LANES, cols), 1)
+    pm = jnp.where(li // g == ci, (1 << ((li % g) * (8 // g))), 0
+                   ).astype(jnp.float32)
+    b = jax.lax.dot(v, pm, preferred_element_type=jnp.float32)  # [R, cols]
+    # row-major reshape to full 128-lane byte rows; flat order == wire order
+    return b.reshape(rows * cols // _LANES, _LANES)
+
+
+def _pack2b_kernel(levels_ref, out_ref):
+    c = levels_ref[:].astype(jnp.float32) + 1.0            # {0,1,2}
+    out_ref[:] = _bytepack(c, 4).astype(jnp.int32).astype(jnp.uint8)
+
+
+def _qsgd_pack_levels_kernel(levels_ref, mag_ref, sign_ref):
+    lv = levels_ref[:].astype(jnp.int32)
+    mag_ref[:] = jnp.abs(lv).astype(jnp.uint8)
+    neg = (lv < 0).astype(jnp.float32)
+    sign_ref[:] = _bytepack(neg, 8).astype(jnp.int32).astype(jnp.uint8)
+
+
+def _pack_bytes_call(kernel, levels: Array, out_divs, out_dtypes,
+                     interpret: bool):
+    """Shared pallas_call plumbing for the byte packers: grid over
+    _QPACK_ROWS-row level chunks, one output per (rows-divisor, dtype)."""
+    n = levels.shape[0]
+    x2d, num_chunks = _pad_chunks(levels, fill=0, rows=_QPACK_ROWS)
+    vma = _vma(levels)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(num_chunks,),
+        in_specs=[pl.BlockSpec((_QPACK_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec((_QPACK_ROWS // d, _LANES), lambda i, d=d: (i, 0),
+                                memory_space=pltpu.VMEM) for d in out_divs],
+        out_shape=[compat.shape_dtype_struct((x2d.shape[0] // d, _LANES), dt,
+                                             vma=vma)
+                   for d, dt in zip(out_divs, out_dtypes)],
+        interpret=interpret,
+    )(x2d)
+    return outs
+
+
+def pack_ternary_pallas(levels: Array, *, interpret: bool | None = None) -> Array:
+    """``uint8[ceil(n/4)]`` — bitwise-identical to :func:`wire.pack_ternary`
+    (the XLA packer zero-pads levels to a multiple of 4; chunk padding here
+    is level 0 -> code 1, the same byte content)."""
+    n = levels.shape[0]
+    if interpret is None:
+        interpret = _auto_interpret()
+    (out,) = _pack_bytes_call(_pack2b_kernel, levels.astype(jnp.int8),
+                              (4,), (jnp.uint8,), interpret)
+    return out.reshape(-1)[: -(-n // 4)]
+
+
+def qsgd_pack_pallas(levels: Array, *, interpret: bool | None = None):
+    """``(uint8 mags [n], uint8 signs [ceil(n/8)])`` — bitwise-identical to
+    :func:`wire.qsgd_wire_pack` for ``qstates <= 255`` given the same int16
+    levels."""
+    n = levels.shape[0]
+    if interpret is None:
+        interpret = _auto_interpret()
+    mags, signs = _pack_bytes_call(
+        _qsgd_pack_levels_kernel, levels.astype(jnp.int16),
+        (1, 8), (jnp.uint8, jnp.uint8), interpret)
+    return mags.reshape(-1)[:n], signs.reshape(-1)[: -(-n // 8)]
+
+
+def _terngrad_pack_kernel(seed_ref, inv_max_ref, x_ref, out_ref):
+    pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+    x = x_ref[:]
+    u = _uniform_from_bits(x.shape)
+    keep = (u < jnp.abs(x) * inv_max_ref[0, 0]).astype(jnp.float32)
+    codes = _sign(x) * keep + 1.0                          # {0,1,2}
+    out_ref[:] = _bytepack(codes, 4).astype(jnp.int32).astype(jnp.uint8)
+
+
+def _qsgd_pack_kernel(qstates: int, seed_ref, inv_norm_ref, x_ref,
+                      mag_ref, sign_ref):
+    pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+    x = x_ref[:]
+    u = _uniform_from_bits(x.shape)
+    lv = jnp.floor(jnp.abs(x) * inv_norm_ref[0, 0] * qstates + u)
+    mag_ref[:] = lv.astype(jnp.int32).astype(jnp.uint8)
+    # sign bit set iff the signed level is negative: x < 0 AND lv > 0
+    # (jnp.sign(x) * 0 == +-0, never < 0 — matches qsgd_wire_pack)
+    neg = jnp.logical_and(x < 0, lv > 0).astype(jnp.float32)
+    sign_ref[:] = _bytepack(neg, 8).astype(jnp.int32).astype(jnp.uint8)
+
+
+def _run_quant_pack(kernel, flat: Array, inv_scale: Array, seed: Array,
+                    out_divs, interpret: bool):
+    n = flat.shape[0]
+    x2d, num_chunks = _pad_chunks(flat.astype(jnp.float32), fill=0.0,
+                                  rows=_QPACK_ROWS)
+    vma = _vma(flat)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(num_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((_QPACK_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((_QPACK_ROWS // d, _LANES), lambda i, d=d: (i, 0),
+                                memory_space=pltpu.VMEM) for d in out_divs],
+        out_shape=[compat.shape_dtype_struct((x2d.shape[0] // d, _LANES),
+                                             jnp.uint8, vma=vma)
+                   for d in out_divs],
+        # hardware PRNG — TPU-semantics interpreter required off-TPU
+        interpret=compat.pallas_interpret_params() if interpret else False,
+    )(
+        seed.reshape(1, 1).astype(jnp.int32),
+        inv_scale.reshape(1, 1).astype(jnp.float32),
+        x2d,
+    )
+    return outs
+
+
+def terngrad_pack(flat: Array, key: Array, *,
+                  interpret: bool | None = None) -> Tuple[Array, Array]:
+    """Fused TernGrad quantize+pack: ``(uint8 wire bytes [ceil(n/4)], fp32
+    scale)`` — draw, dither, and 2-bit-pack in ONE pass instead of the
+    levels pass + XLA `pack_ternary` pass.  Same hardware-PRNG stream caveat
+    as :func:`terngrad_quantize` (unbiased, not bitwise with `jax.random`);
+    chunk padding packs as code 1 exactly like the XLA packer's zero-pad."""
+    if interpret is None:
+        interpret = _auto_interpret() and compat.HAS_TPU_INTERPRET
+    gmax = jnp.max(jnp.abs(flat.astype(jnp.float32)))
+    inv = jnp.where(gmax > 0, 1.0 / jnp.where(gmax > 0, gmax, 1.0), 0.0)
+    (packed,) = _run_quant_pack(
+        _terngrad_pack_kernel, flat, inv, _seed_from_key(key), (4,), interpret)
+    n = flat.shape[0]
+    return packed.reshape(-1)[: -(-n // 4)], gmax
+
+
+def terngrad_pack_prescaled(scaled: Array, key: Array, *,
+                            interpret: bool | None = None) -> Array:
+    """Quantize+pack for an already chunk-normalised input (``|x| <= 1``) —
+    the chunked-scale TernGrad path's fused second pass."""
+    if interpret is None:
+        interpret = _auto_interpret() and compat.HAS_TPU_INTERPRET
+    (packed,) = _run_quant_pack(
+        _terngrad_pack_kernel, scaled, jnp.asarray(1.0, jnp.float32),
+        _seed_from_key(key), (4,), interpret)
+    n = scaled.shape[0]
+    return packed.reshape(-1)[: -(-n // 4)]
+
+
+def qsgd_pack(flat: Array, key: Array, *, qstates: int = 255,
+              interpret: bool | None = None):
+    """Fused QSGD quantize+pack for the uint8 wire layout (``qstates <=
+    255``): ``(uint8 mags [n], uint8 sign bitmap [ceil(n/8)], fp32 scale)``
+    in one pass — replacing levels + `qsgd_wire_pack`'s abs/compare/pack_bits
+    chain.  Hardware-PRNG stream caveat as :func:`qsgd_quantize`."""
+    if not 0 < qstates <= 255:
+        raise ValueError(f"qsgd_pack packs uint8 magnitudes; qstates={qstates}")
+    if interpret is None:
+        interpret = _auto_interpret() and compat.HAS_TPU_INTERPRET
+    norm = jnp.linalg.norm(flat.astype(jnp.float32))
+    inv = jnp.where(norm > 0, 1.0 / jnp.where(norm > 0, norm, 1.0), 0.0)
+    mags, signs = _run_quant_pack(
+        functools.partial(_qsgd_pack_kernel, qstates), flat, inv,
+        _seed_from_key(key), (1, 8), interpret)
+    n = flat.shape[0]
+    scale = jnp.where(norm > 0, norm, 0.0) / qstates
+    return mags.reshape(-1)[:n], signs.reshape(-1)[: -(-n // 8)], scale
+
+
+def use_quant_pack(n: int) -> bool:
+    """Whether the fused quantize+pack kernels should serve this tensor.
+    Off-TPU (including ``force``) they need the TPU-semantics interpreter —
+    the stock interpreter's PRNG stub would silently zero the dither."""
+    if not _dispatch_to_pallas(n):
+        return False
+    return jax.default_backend() == "tpu" or compat.HAS_TPU_INTERPRET
 
 
 # ---------------------------------------------------------------------------
@@ -1124,3 +1587,115 @@ def uniform(key: Array, n: int) -> Array:
     if _dispatch_to_pallas(n):
         return _uniform_pallas(_seed_from_key(key), n)
     return jax.random.uniform(key, (n,))
+
+
+# ---------------------------------------------------------------------------
+# Fused bucket route (sharded transport per-destination bucket build)
+# ---------------------------------------------------------------------------
+#
+# The sharded transport's route phase turns the ascending (value, index)
+# payload into per-destination fixed-capacity buckets.  The XLA build is a
+# pair of [W*cap+1]-slot scatters (value add + index set with a dump slot).
+# Because the indices are ascending, each destination's accepted elements
+# are a CONTIGUOUS window [starts[w], starts[w] + min(count, cap)) of the
+# payload — so the scatter is really W windowed copies.  The kernel grids
+# over destinations, DMAs each window from HBM at its dynamic start offset,
+# masks the tail, and writes full bucket rows: zero value / `shard_n` guard
+# index on empty slots, identical bit-for-bit to the scatter build, and
+# rows stay monotone (window order = payload order), preserving the
+# owner-side sorted-scatter hints.
+
+# per-destination window bound: 2 value+index scratch windows of cap_p
+# elements must sit in VMEM alongside the output block
+_ROUTE_MAX_CAPP = 1 << 15
+
+
+def _bucket_route_kernel(cap: int, cap_p: int, shard_n: int,
+                         starts_ref, counts_ref, vals_ref, idx_ref,
+                         bv_ref, bi_ref, scratch_v, scratch_i, sem_v, sem_i):
+    w = pl.program_id(0)
+    start = starts_ref[w]
+    cnt = jnp.minimum(counts_ref[w], cap)
+    # dynamic element-offset DMA: the payload is padded by cap_p so the last
+    # destination's window read stays in bounds whatever its start
+    cv = pltpu.make_async_copy(vals_ref.at[pl.ds(start, cap_p)], scratch_v,
+                               sem_v)
+    ci = pltpu.make_async_copy(idx_ref.at[pl.ds(start, cap_p)], scratch_i,
+                               sem_i)
+    cv.start()
+    ci.start()
+    cv.wait()
+    ci.wait()
+    r2 = cap_p // _LANES
+    v = scratch_v[:].reshape(r2, _LANES)
+    ix = scratch_i[:].reshape(r2, _LANES)
+    pos = (jax.lax.broadcasted_iota(jnp.int32, (r2, _LANES), 0) * _LANES
+           + jax.lax.broadcasted_iota(jnp.int32, (r2, _LANES), 1))
+    take = pos < cnt
+    bv_ref[:] = jnp.where(take, v, jnp.zeros((), v.dtype))
+    # bucket-local index; empty slots carry the shard_n guard row the owner
+    # reduce scatters into
+    bi_ref[:] = jnp.where(take, ix - w * shard_n, shard_n)
+
+
+def fused_bucket_route(vals: Array, idx: Array, dest: Array, world: int,
+                       cap: int, shard_n: int, *,
+                       interpret: bool | None = None):
+    """``(bvals [W, cap], bidx [W, cap])`` — the sharded transport's
+    per-destination buckets, built as W windowed copies instead of a
+    [W*cap+1] scatter pair.  ``dest`` is the per-element destination (dump
+    value ``world`` for invalid tail slots), ascending by the payload's
+    monotone-index contract."""
+    k = vals.shape[0]
+    if interpret is None:
+        interpret = _auto_interpret()
+    cap_p = -(-cap // _LANES) * _LANES
+    r2 = cap_p // _LANES
+    # per-destination totals and exclusive starts (tiny: W+1 buckets); the
+    # dump bucket keeps invalid tail slots out of every window
+    counts_all = jnp.zeros((world + 1,), jnp.int32).at[dest].add(
+        1, indices_are_sorted=True, mode="promise_in_bounds")
+    starts = (jnp.cumsum(counts_all) - counts_all)[:world].astype(jnp.int32)
+    counts = counts_all[:world]
+    vpad = jnp.concatenate([vals, jnp.zeros((cap_p,), vals.dtype)])
+    ipad = jnp.concatenate([idx, jnp.zeros((cap_p,), jnp.int32)])
+    vma = _vma(vals)
+    outs = pl.pallas_call(
+        functools.partial(_bucket_route_kernel, int(cap), cap_p, int(shard_n)),
+        grid=(world,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((r2, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r2, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            compat.shape_dtype_struct((world * r2, _LANES), vals.dtype, vma=vma),
+            compat.shape_dtype_struct((world * r2, _LANES), jnp.int32, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((cap_p,), vals.dtype),
+            pltpu.VMEM((cap_p,), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(starts, counts, vpad, ipad)
+    bvals = outs[0].reshape(world, cap_p)[:, :cap]
+    bidx = outs[1].reshape(world, cap_p)[:, :cap]
+    return bvals, bidx
+
+
+def use_bucket_route(k: int, world: int, cap: int) -> bool:
+    """Whether the sharded route phase should take the fused window kernel.
+    Element-granular payloads only (the blocky Block-Top-K row layout keeps
+    the XLA scatter); the window bound keeps both scratch copies in VMEM."""
+    cap_p = -(-cap // _LANES) * _LANES
+    return (_dispatch_to_pallas(k) and k <= _INT32_MAX and world >= 2
+            and cap_p <= _ROUTE_MAX_CAPP)

@@ -185,6 +185,25 @@ def _sorted_gather(a: Array, idx: Array) -> Array:
     return a.at[idx].get(indices_are_sorted=True, mode="promise_in_bounds")
 
 
+def _select_pack(flat: Array, mag: Array, t, keep: int):
+    """``(payload [keep], idx [keep], survivor count)``: the coordinates
+    with ``mag >= t`` by ascending index — the wire select+pack step.
+
+    One fused Pallas pass (`kernels.fused_select_pack`) when dispatched;
+    otherwise the XLA mask -> `packed_indices_from_mask` -> `_sorted_gather`
+    chain.  Payloads are bitwise identical across the two paths whenever
+    ``count >= keep`` (`topk_threshold`'s guarantee; parity-gated in
+    tests/test_kernels.py) — underfull masks differ only in the padding
+    slots, which every caller re-masks or treats as scatter identities."""
+    from tpu_compressed_dp.ops import kernels
+
+    if kernels.use_select_pack(flat.shape[0], keep):
+        return kernels.fused_select_pack(flat, t, keep)
+    mask = mag >= t
+    idx = packed_indices_from_mask(mask, keep)
+    return _sorted_gather(flat, idx), idx, jnp.sum(mask, dtype=jnp.int32)
+
+
 def _scatter_combine(shape, dtype, g_idx: Array, g_vals: Array, world,
                      block_size: int = 0) -> Array:
     """Gathered ``[W, k]`` (indices, values) payload -> dense sum / world.
@@ -338,9 +357,7 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world,
 
     mag = jnp.abs(flat).astype(jnp.float32)
     t = kernels.topk_threshold(mag, keep)
-    mask = mag >= t
-    idx = packed_indices_from_mask(mask, keep)
-    payload = _sorted_gather(flat, idx)            # [k] values + [k] indices travel
+    payload, idx, count = _select_pack(flat, mag, t, keep)
     bits = _payload_bits(payload, idx)
     g_vals = _all_gather(payload, axis_name)       # [W, k]
     g_idx = _all_gather(idx, axis_name)            # [W, k]
@@ -348,8 +365,7 @@ def _leaf_sync_topk(flat: Array, keep: int, axis_name: str, world,
     # above-threshold survivors beyond `keep` (histogram bin-resolution ties/
     # surplus) are truncated by ascending index; with EF off they are silently
     # dropped — surface the count so callers can see it (ADVICE r2)
-    surplus = (jnp.maximum(jnp.sum(mask, dtype=jnp.int32) - keep, 0)
-               if want_surplus else None)
+    surplus = jnp.maximum(count - keep, 0) if want_surplus else None
     return dense, idx, surplus, bits
 
 
@@ -406,7 +422,9 @@ def _leaf_sync_blocktopk(flat: Array, keep_blocks: int, block_size: int,
     n = flat.shape[0]
     scores = compressors.blocktopk_scores(flat, block_size)
     t = kernels.topk_threshold(scores, keep_blocks)
-    bidx = packed_indices_from_mask(scores >= t, keep_blocks)
+    # scores are non-negative, so they serve as their own magnitudes for the
+    # fused select+pack dispatch; only the index stream is consumed here
+    bidx = _select_pack(scores, scores, t, keep_blocks)[1]
     if block_size < 128 and 128 % block_size == 0:
         return _blocktopk_small_bs(flat, bidx, block_size, axis_name, world,
                                    want_ef)
@@ -512,13 +530,11 @@ def _leaf_sync_threshold(flat: Array, v, cap: int, axis_name: str, world,
     ``overflow`` how many survivors were clipped by the capacity.
     """
     mag = jnp.abs(flat)
-    mask = mag >= v
-    count = jnp.sum(mask, dtype=jnp.int32)
+    vals, idx, count = _select_pack(flat, mag, v, cap)
     sent_count = jnp.minimum(count, cap)
-    idx = packed_indices_from_mask(mask, cap)
     rank = jnp.arange(1, cap + 1, dtype=jnp.int32)
     valid = rank <= sent_count
-    vals = jnp.where(valid, flat[idx], 0.0)
+    vals = jnp.where(valid, vals, 0.0)
     idx = jnp.where(valid, idx, 0)
     bits = _payload_bits(vals, idx)                  # the full cap-sized buffer
     g_vals = _all_gather(vals, axis_name)            # [W, cap]
@@ -670,9 +686,7 @@ def _leaf_sync_topk_sharded(flat: Array, keep: int, axis_name: str, world,
 
     mag = jnp.abs(flat).astype(jnp.float32)
     t = kernels.topk_threshold(mag, keep)
-    mask = mag >= t
-    idx = packed_indices_from_mask(mask, keep)
-    vals = _sorted_gather(flat, idx)
+    vals, idx, count = _select_pack(flat, mag, t, keep)
     plan = _shard_plan(cfg, flat.shape[0], keep, world, 1)
     dense_u, sent, route_bits, ret_bits, overflow = (
         wire_sharded.sharded_combine(vals, idx, plan, axis_name))
@@ -689,8 +703,7 @@ def _leaf_sync_topk_sharded(flat: Array, keep: int, axis_name: str, world,
     # threshold survivors beyond `keep` are a selection-stage drop, reported
     # under its own key — folding it into shard_overflow would pollute the
     # capacity-sizing signal (the factors cannot drive a tie surplus to 0)
-    surplus = (None if want_ef else jnp.maximum(
-        jnp.sum(mask, dtype=jnp.int32) - keep, 0))
+    surplus = None if want_ef else jnp.maximum(count - keep, 0)
     # sent_elems = coordinates the synced gradient actually contains
     # (route-accepted AND returned) — same semantics as threshold-sharded,
     # dynamic when the capacity factors clip
@@ -711,7 +724,8 @@ def _leaf_sync_blocktopk_sharded(flat: Array, keep_blocks: int,
     n = flat.shape[0]
     scores = compressors.blocktopk_scores(flat, block_size)
     t = kernels.topk_threshold(scores, keep_blocks)
-    bidx = packed_indices_from_mask(scores >= t, keep_blocks)
+    # scores are non-negative, so they serve as their own magnitudes
+    bidx = _select_pack(scores, scores, t, keep_blocks)[1]
     g2 = compressors.blocktopk_blocks(flat, block_size)     # [nb, bs]
     payload = _sorted_gather(g2, bidx)                      # [kb, bs]
     plan = _shard_plan(cfg, g2.shape[0], keep_blocks, world, block_size)
@@ -742,13 +756,11 @@ def _leaf_sync_threshold_sharded(flat: Array, v, cap: int, axis_name: str,
     from tpu_compressed_dp.ops import wire_sharded
 
     mag = jnp.abs(flat)
-    mask = mag >= v
-    count = jnp.sum(mask, dtype=jnp.int32)
+    vals, idx, count = _select_pack(flat, mag, v, cap)
     sent_count = jnp.minimum(count, cap)
-    idx = packed_indices_from_mask(mask, cap)
     rank = jnp.arange(1, cap + 1, dtype=jnp.int32)
     valid = rank <= sent_count
-    vals = jnp.where(valid, flat.at[idx].get(mode="promise_in_bounds"), 0.0)
+    vals = jnp.where(valid, vals, 0.0)
     plan = _shard_plan(cfg, flat.shape[0], cap, world, 1)
     dense_u, sent, route_bits, ret_bits, overflow = (
         wire_sharded.sharded_combine(vals, idx, plan, axis_name, valid=valid))
@@ -774,9 +786,7 @@ def _leaf_sync_topk_hier(flat: Array, keep: int, axis_name: str, world,
 
     mag = jnp.abs(flat).astype(jnp.float32)
     t = kernels.topk_threshold(mag, keep)
-    mask = mag >= t
-    idx = packed_indices_from_mask(mask, keep)
-    vals = _sorted_gather(flat, idx)
+    vals, idx, count = _select_pack(flat, mag, t, keep)
     contrib = jnp.zeros(flat.shape, flat.dtype).at[idx].set(
         vals, indices_are_sorted=True, unique_indices=True,
         mode="promise_in_bounds")
@@ -784,8 +794,7 @@ def _leaf_sync_topk_hier(flat: Array, keep: int, axis_name: str, world,
         contrib, keep, axis_name, world, cfg)
     dense = (total / world).astype(flat.dtype)
     new_ef = (flat - contrib + ef_extra) if want_ef else None
-    surplus = (None if want_ef else jnp.maximum(
-        jnp.sum(mask, dtype=jnp.int32) - keep, 0))
+    surplus = None if want_ef else jnp.maximum(count - keep, 0)
     return dense, new_ef, (b_ici, b_rt, b_ret), overflow, surplus
 
 
@@ -799,7 +808,8 @@ def _leaf_sync_blocktopk_hier(flat: Array, keep_blocks: int, block_size: int,
     n = flat.shape[0]
     scores = compressors.blocktopk_scores(flat, block_size)
     t = kernels.topk_threshold(scores, keep_blocks)
-    bidx = packed_indices_from_mask(scores >= t, keep_blocks)
+    # scores are non-negative, so they serve as their own magnitudes
+    bidx = _select_pack(scores, scores, t, keep_blocks)[1]
     g2 = compressors.blocktopk_blocks(flat, block_size)     # [nb, bs]
     payload = _sorted_gather(g2, bidx)                      # [kb, bs]
     contrib = jnp.zeros(g2.shape, flat.dtype).at[bidx].set(
@@ -819,13 +829,11 @@ def _leaf_sync_threshold_hier(flat: Array, v, cap: int, axis_name: str,
     matter — it never enters ``contrib`` so it lands in the base residual;
     transport clips refund through :func:`_hier_combine`."""
     mag = jnp.abs(flat)
-    mask = mag >= v
-    count = jnp.sum(mask, dtype=jnp.int32)
+    vals, idx, count = _select_pack(flat, mag, v, cap)
     sent_count = jnp.minimum(count, cap)
-    idx = packed_indices_from_mask(mask, cap)
     rank = jnp.arange(1, cap + 1, dtype=jnp.int32)
     valid = rank <= sent_count
-    vals = jnp.where(valid, flat.at[idx].get(mode="promise_in_bounds"), 0.0)
+    vals = jnp.where(valid, vals, 0.0)
     idx = jnp.where(valid, idx, 0)
     # add, not set: the zero-padded tail slots all alias coordinate 0 and
     # must not clobber a genuinely selected value there
@@ -841,9 +849,20 @@ def _leaf_sync_threshold_hier(flat: Array, v, cap: int, axis_name: str,
 
 def _leaf_sync_terngrad(flat: Array, key: Array, chunk: int, axis_name: str,
                         world):
+    from tpu_compressed_dp.ops import kernels
+
     n = flat.shape[0]
-    levels, scale = compressors.terngrad_levels(flat, key, chunk=chunk)
-    packed = pack_ternary(levels)                         # uint8[ceil(n/4)]
+    if kernels.use_quant_pack(n):
+        # fused quantize+pack: dither and 2-bit wire bytes in one kernel
+        # pass, no materialised int8 level vector (bitwise-identical bytes)
+        if compressors.terngrad_num_chunks(n, chunk) == 1:
+            packed, scale = kernels.terngrad_pack(flat, key)
+        else:
+            scaled, scale = compressors.terngrad_prescale(flat, chunk)
+            packed = kernels.terngrad_pack_prescaled(scaled, key)
+    else:
+        levels, scale = compressors.terngrad_levels(flat, key, chunk=chunk)
+        packed = pack_ternary(levels)                     # uint8[ceil(n/4)]
     bits = _payload_bits(packed, scale)
     g_packed = _all_gather(packed, axis_name)             # [W, ceil(n/4)]
     g_scale = _all_gather(scale, axis_name)               # [W] or [W, nc]
@@ -863,9 +882,17 @@ def _leaf_sync_terngrad(flat: Array, key: Array, chunk: int, axis_name: str,
 
 
 def _leaf_sync_qsgd(flat: Array, key: Array, qstates: int, axis_name: str, world):
+    from tpu_compressed_dp.ops import kernels
+
     n = flat.shape[0]
-    levels, scale = compressors.qsgd_levels(flat, key, qstates=qstates)
-    payload = qsgd_wire_pack(levels, qstates)
+    if 127 < qstates <= 255 and kernels.use_quant_pack(n):
+        # fused quantize+pack emits the byte-magnitude + packed-sign wire
+        # format directly (the qstates <= 255 branch of qsgd_wire_pack)
+        mags, signs, scale = kernels.qsgd_pack(flat, key, qstates=qstates)
+        payload = (mags, signs)
+    else:
+        levels, scale = compressors.qsgd_levels(flat, key, qstates=qstates)
+        payload = qsgd_wire_pack(levels, qstates)
     bits = _payload_bits(*payload, scale)
     g_payload = tuple(_all_gather(p, axis_name) for p in payload)
     g_scale = _all_gather(scale, axis_name)               # [W]

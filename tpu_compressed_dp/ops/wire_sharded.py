@@ -315,6 +315,7 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
       return-clipped union count (psum for the global figure).
     """
     from tpu_compressed_dp.obs import trace as obs_trace
+    from tpu_compressed_dp.ops import kernels
     from tpu_compressed_dp.ops.wire import (_all_gather, _payload_bits,
                                             packed_indices_from_mask)
 
@@ -341,12 +342,19 @@ def sharded_combine(vals: Array, idx: Array, plan: ShardPlan,
     # dump slot W*cap, sliced off before the collective, so their values
     # need no masking.
     with obs_trace.phase("route"):
-        bvals = jnp.zeros((W * cap + 1,) + vals.shape[1:], vals.dtype
-                          ).at[slot].add(vals)[:-1]
-        bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
-                        ).at[slot].set(local)[:-1]
-        bvals = bvals.reshape((W, cap) + vals.shape[1:])
-        bidx = bidx.reshape(W, cap)
+        if not blocky and kernels.use_bucket_route(idx.shape[0], W, cap):
+            # fused bucket build: each destination's accepted slots are a
+            # contiguous window of the ascending payload, DMA'd and masked
+            # in one kernel pass (bitwise-identical buckets, monotone rows)
+            bvals, bidx = kernels.fused_bucket_route(
+                vals, idx, dest, W, cap, shard_n)
+        else:
+            bvals = jnp.zeros((W * cap + 1,) + vals.shape[1:], vals.dtype
+                              ).at[slot].add(vals)[:-1]
+            bidx = jnp.full((W * cap + 1,), shard_n, jnp.int32
+                            ).at[slot].set(local)[:-1]
+            bvals = bvals.reshape((W, cap) + vals.shape[1:])
+            bidx = bidx.reshape(W, cap)
         route_bits = _payload_bits(bvals, bidx)
         rvals = jax.lax.all_to_all(
             bvals, axis_name, 0, 0,

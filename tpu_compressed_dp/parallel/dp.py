@@ -756,18 +756,26 @@ def make_grad_sync(cfg: CompressionConfig, axis_name: str = "data", *,
                 acc = flat + group_concat(ef_leaves, idxs) if use_ef else flat
             n_g = flat.shape[0]
             with obs_trace.phase("compress"):
-                if (comp.name == "topk" and acc.dtype == jnp.float32
-                        and kernels.use_fused_sparsify(n_g)):
-                    # fused epilogue: threshold-mask + compress + residual +
-                    # nonzero count in ONE pass over the accumulated gradient
-                    # (pallas_call boundaries block XLA from fusing the
-                    # where/subtract/count chain around the threshold kernel).
-                    # fp32-gated so the psum payload dtype matches the unfused
-                    # path.
-                    keep = compressors.topk_keep_count(n_g, cfg.ratio)
-                    t = kernels.topk_threshold(jnp.abs(acc), keep)
+                # fused epilogue: threshold-mask + compress + residual +
+                # nonzero count in ONE pass over the accumulated gradient
+                # (pallas_call boundaries block XLA from fusing the
+                # where/subtract/count chain around the threshold kernel).
+                # fp32-gated so the psum payload dtype matches the unfused
+                # path.  Every |g| >= t selection rides the same kernel:
+                # top-k (histogram threshold), threshold-V (the static V),
+                # adaptive (2|g| >= max ⟺ |g| >= max/2, exact in binary fp).
+                fuse_t = None
+                if acc.dtype == jnp.float32 and kernels.use_fused_sparsify(n_g):
+                    if comp.name == "topk":
+                        keep = compressors.topk_keep_count(n_g, cfg.ratio)
+                        fuse_t = kernels.topk_threshold(jnp.abs(acc), keep)
+                    elif comp.name == "thresholdv":
+                        fuse_t = jnp.float32(cfg.threshold)
+                    elif comp.name == "adaptive_threshold":
+                        fuse_t = 0.5 * jnp.max(jnp.abs(acc))
+                if fuse_t is not None:
                     comp_flat, new_ef_flat, group_sent = kernels.fused_sparsify(
-                        acc, t, want_ef=use_ef)
+                        acc, fuse_t, want_ef=use_ef)
                     group_bits = group_sent * bits_per_elem
                 else:
                     comp_flat = compress_flat(acc, key, gi)
