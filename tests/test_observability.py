@@ -374,6 +374,30 @@ class TestWatchdog:
         # without the flag the checkpoint clock is never consulted
         assert wd.main(["--check", "--heartbeat", p]) == 0
 
+    def test_max_straggler_skew_cli(self, tmp_path):
+        """--max_straggler_skew reads the flight recorder's live
+        straggler_skew_s the harnesses fold into the heartbeat."""
+        import tools.watchdog as wd
+
+        p = self._hb(tmp_path, straggler_skew_s=2.5, straggler_rank=3)
+        assert wd.main(["--check", "--heartbeat", p,
+                        "--max_straggler_skew", "5"]) == 0
+        assert wd.main(["--check", "--heartbeat", p,
+                        "--max_straggler_skew", "1"]) == 1
+        # without the flag the skew gauge is never consulted
+        assert wd.main(["--check", "--heartbeat", p]) == 0
+
+    def test_max_straggler_skew_unit(self, tmp_path):
+        from tpu_compressed_dp.utils.resilience import check_heartbeat
+
+        p = self._hb(tmp_path, straggler_skew_s=2.5, straggler_rank=3)
+        probs = check_heartbeat(p, max_straggler_skew_s=1.0)
+        assert probs and "straggler" in probs[0]
+        assert check_heartbeat(p, max_straggler_skew_s=5.0) == []
+        # a heartbeat that never published the gauge skips the check
+        q = self._hb(tmp_path)
+        assert check_heartbeat(q, max_straggler_skew_s=0.001) == []
+
 
 @pytest.mark.quick
 class TestWatchdogRelaunch:
@@ -847,3 +871,424 @@ def test_imagenet_harness_tensorboard_integration(tmp_path):
     assert ep["step_spans"] and ep["timeline"]["time/steps_per_sec"] > 0
     report = tr.render_report(events)
     assert "per-phase step-time breakdown" in report and "MFU" in report
+
+
+@pytest.mark.quick
+class TestEventStreamRotation:
+    """--events_max_mb size-capped streams: rotation is atomic, every
+    record carries its segment index, and the reader stitches segments
+    back into one ordered stream (ISSUE 15 satellite)."""
+
+    def test_rotate_and_stitch(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with obs_export.EventStream(p, meta={"harness": "t"},
+                                    max_bytes=256) as es:
+            for i in range(20):
+                es.emit("step", step=i, metrics={"loss": 1.0})
+        segs = obs_export.list_segments(p)
+        assert segs, "256-byte cap over 20 records must rotate"
+        # live file still parses on its own; stitched view sees everything
+        assert os.path.exists(p)
+        events = obs_export.read_all_events(p)
+        kinds = [e["kind"] for e in events]
+        assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+        assert [e["step"] for e in events if e["kind"] == "step"] \
+            == list(range(20))
+        # every record names its segment; indices ascend across the stitch
+        seg_ids = [e["seg"] for e in events]
+        assert seg_ids == sorted(seg_ids)
+        assert seg_ids[-1] == len(segs)
+        # no torn tmp files left behind by the atomic replace
+        assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+    def test_resume_continues_numbering(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with obs_export.EventStream(p, max_bytes=200) as es:
+            for i in range(10):
+                es.emit("step", step=i)
+        n_segs = len(obs_export.list_segments(p))
+        assert n_segs >= 1
+        with obs_export.EventStream(p, max_bytes=200) as es:
+            for i in range(10, 20):
+                es.emit("step", step=i)
+        assert len(obs_export.list_segments(p)) > n_segs
+        steps = [e["step"] for e in obs_export.read_all_events(p)
+                 if e["kind"] == "step"]
+        assert steps == list(range(20))
+
+    def test_unbounded_stays_single_file(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with obs_export.EventStream(p) as es:
+            for i in range(50):
+                es.emit("step", step=i)
+        assert obs_export.list_segments(p) == []
+        assert len(obs_export.read_all_events(p)) \
+            == len(obs_export.read_events(p)) == 52
+
+
+@pytest.mark.quick
+class TestFlightRecorder:
+    def _fl(self, tmp_path=None, **kw):
+        from tpu_compressed_dp.obs.flight import FlightRecorder
+
+        kw.setdefault("rank", 0)
+        kw.setdefault("capacity", 8)
+        if tmp_path is not None:
+            kw.setdefault("directory", str(tmp_path))
+        return FlightRecorder(**kw)
+
+    def test_rings_bounded_under_hammer(self):
+        """O(capacity) memory: 10k notes never grow any ring past the
+        cap, while the counters keep exact totals (ISSUE 15 acceptance)."""
+        fl = self._fl(capacity=8)
+        for i in range(10_000):
+            fl.note_step(i, {"loss": 1.0, "guard/skipped": 0.0})
+        snap = fl.snapshot()
+        assert all(len(ring) <= 8 for ring in snap["rings"].values())
+        # note_step with a guard/ key writes two records (step + guard)
+        assert snap["records"] == 20_000
+        m = fl.metrics()
+        assert m["flight/records"] == 20_000.0
+        assert m["flight/dumps"] == 0.0 and m["flight/last_dump_step"] == -1.0
+        # newest records win: the step ring holds the tail of the run
+        assert [r["step"] for r in snap["rings"]["step"]] \
+            == list(range(9_992, 10_000))
+
+    def test_unknown_channel_and_bad_capacity(self):
+        from tpu_compressed_dp.obs.flight import FlightRecorder
+
+        fl = self._fl()
+        with pytest.raises(ValueError, match="unknown flight channel"):
+            fl.record("typo", "oops")
+        with pytest.raises(ValueError, match="capacity"):
+            FlightRecorder(capacity=0)
+
+    def test_observe_dump_roundtrip(self, tmp_path):
+        from tpu_compressed_dp.obs import flight as fli
+        from tpu_compressed_dp.train.elastic import PeerFailed
+
+        fl = self._fl(tmp_path, meta={"harness": "t"})
+        fl.note_step(5, {"loss": 2.0})
+        err = PeerFailed((3, 1), step=5, reason="gossip stale")
+        path = fl.observe(err)
+        assert path == fli.bundle_path(str(tmp_path), 0)
+        bundles = fli.read_bundles(str(tmp_path))
+        assert set(bundles) == {0}
+        b = bundles[0]
+        assert fli.validate_bundle(b) == []
+        assert b["reason"] == "peer_failed" and b["step"] == 5
+        assert b["error"]["failed"] == [1, 3]  # ctor sorts the tuple
+        assert b["rings"]["fault"][-1]["kind"] == "peer_failed"
+        assert b["rings"]["step"][-1]["metrics"] == {"loss": 2.0}
+        assert fl.metrics()["flight/dumps"] == 1.0
+        assert fl.metrics()["flight/last_dump_step"] == 5.0
+
+    def test_observe_without_directory_is_noop_dump(self):
+        fl = self._fl()
+        assert fl.observe(RuntimeError("boom"), step=1) is None
+        assert fl.metrics()["flight/dumps"] == 0.0
+        assert fl.snapshot()["rings"]["fault"]  # evidence still recorded
+
+    def test_classify_failure_mapping(self):
+        from tpu_compressed_dp.obs.flight import classify_failure
+        from tpu_compressed_dp.train.elastic import PeerFailed
+        from tpu_compressed_dp.train.guard import GuardExceeded
+        from tpu_compressed_dp.utils import chaos, resilience
+        from tpu_compressed_dp.utils.checkpoint import CheckpointCorrupt
+
+        assert classify_failure(GuardExceeded("wedged")) == "guard_exceeded"
+        assert classify_failure(PeerFailed((1,))) == "peer_failed"
+        assert classify_failure(resilience.Preempted("sig")) == "preempt"
+        assert classify_failure(CheckpointCorrupt("bad")) == "ckpt_corrupt"
+        assert classify_failure(chaos.ChaosCrash("kill")) == "chaos_crash"
+        assert classify_failure(RuntimeError("?")) == "error"
+
+    def test_note_chaos_uses_fault_kind(self):
+        from tpu_compressed_dp.utils.chaos import ChaosConfig
+
+        fl = self._fl()
+        fl.note_chaos(ChaosConfig(kind="nan", target="grads", every=1,
+                                  worker=1))
+        fl.note_chaos("nan:grads")  # spec-string form
+        fl.note_chaos(None)  # disarmed: no record
+        ring = fl.snapshot()["rings"]["chaos"]
+        assert len(ring) == 2
+        assert ring[0]["kind"] == "nan" and ring[0]["worker"] == 1
+        assert ring[1]["kind"] == "armed" and ring[1]["spec"] == "nan:grads"
+
+    def test_publish_single_rank_degrades(self, tmp_path):
+        fl = self._fl(tmp_path)
+        fl.note_spans([{"t0": 1.0, "data": 0.1, "total": 1.0}])
+        g = fl.publish()
+        assert g == {"straggler/skew_s": 0.0, "straggler/rank": -1.0,
+                     "straggler/frac": 0.0}
+
+    def test_registry_conformance(self):
+        """Every gauge the recorder exports (counters + live straggler
+        family) is registry-declared with a host emitter (TCDP103)."""
+        from tpu_compressed_dp.obs.flight import straggler_gauges
+
+        fl = self._fl()
+        names = set(fl.metrics()) | set(straggler_gauges({}))
+        assert names == {"flight/records", "flight/dumps",
+                         "flight/last_dump_step", "straggler/skew_s",
+                         "straggler/rank", "straggler/frac"}
+        for name in names:
+            assert obs_registry.is_declared(name), name
+            assert obs_registry.spec(name).emitter == "host", name
+
+
+@pytest.mark.quick
+class TestStragglerEndToEnd:
+    """Scripted skewed timelines -> shared phase profiles -> live
+    straggler_* gauges -> heartbeat -> watchdog exit 1 (ISSUE 15
+    acceptance: the whole live path, no training loop required)."""
+
+    def _publish(self, tmp_path):
+        from tpu_compressed_dp.obs.flight import FlightRecorder
+
+        gauges = {}
+        for rank, step_s in ((0, 0.10), (1, 0.10), (2, 0.25)):
+            fl = FlightRecorder(rank=rank, capacity=16,
+                                directory=str(tmp_path))
+            fl.note_spans([{"t0": float(i), "data": step_s / 2,
+                            "dispatch": step_s / 2, "total": step_s}
+                           for i in range(4)])
+            gauges = fl.publish()
+        return gauges
+
+    def test_gauges_to_watchdog(self, tmp_path):
+        import time as _time
+
+        import tools.watchdog as wd
+
+        g = self._publish(tmp_path)
+        assert g["straggler/rank"] == 2.0
+        assert g["straggler/skew_s"] == pytest.approx(0.15)
+        assert g["straggler/frac"] == pytest.approx(1.5)
+        # the harness folds the gauges into the heartbeat top level...
+        hb = str(tmp_path / "hb.json")
+        json.dump({"ts": _time.time(), "step": 10, "last_good_step": 10,
+                   "straggler_skew_s": g["straggler/skew_s"],
+                   "straggler_rank": g["straggler/rank"]}, open(hb, "w"))
+        # ...and the watchdog turns a breach into exit 1
+        assert wd.main(["--check", "--heartbeat", hb,
+                        "--max_straggler_skew", "0.05"]) == 1
+        assert wd.main(["--check", "--heartbeat", hb,
+                        "--max_straggler_skew", "0.5"]) == 0
+
+    def test_prometheus_export(self, tmp_path):
+        g = self._publish(tmp_path)
+        prom = str(tmp_path / "m.prom")
+        obs_export.write_prometheus(g, prom, labels={"harness": "t"})
+        body = open(prom).read()
+        assert "# TYPE tcdp_straggler_skew_s gauge" in body
+        assert 'tcdp_straggler_rank{harness="t"} 2' in body
+
+    def test_offline_matches_live(self, tmp_path):
+        """postmortem's straggler_from_bundles recomputes the SAME gauges
+        from dumped timing rings — one skew definition, two surfaces."""
+        from tpu_compressed_dp.obs.flight import FlightRecorder, read_bundles
+        from tools.postmortem import straggler_from_bundles
+
+        live = self._publish(tmp_path)
+        for rank, step_s in ((0, 0.10), (1, 0.10), (2, 0.25)):
+            fl = FlightRecorder(rank=rank, capacity=16,
+                                directory=str(tmp_path))
+            fl.note_spans([{"t0": float(i), "data": step_s / 2,
+                            "dispatch": step_s / 2, "total": step_s}
+                           for i in range(4)])
+            fl.dump("error")
+        offline = straggler_from_bundles(read_bundles(str(tmp_path)))
+        assert offline == pytest.approx(live)
+
+
+@pytest.mark.quick
+class TestTraceReportMerge:
+    def _rank_events(self, tmp_path, rank, lag=0.0):
+        p = str(tmp_path / f"ev.rank{rank}.jsonl")
+        with obs_export.EventStream(p, meta={"harness": "t"}) as es:
+            spans = [{"t0": 100.0 * rank + i, "data": 0.2,
+                      "dispatch": 0.8 + lag, "total": 1.0 + lag}
+                     for i in range(3)]
+            es.emit("epoch", epoch=1, step=3, metrics={},
+                    throughput={}, guard={}, timeline={}, step_spans=spans)
+        return p
+
+    def test_merge_cli(self, tmp_path):
+        import tools.trace_report as tr
+
+        p0 = self._rank_events(tmp_path, 0)
+        p1 = self._rank_events(tmp_path, 1, lag=0.5)
+        out = str(tmp_path / "merged.json")
+        assert tr.main([p0, p1, "--merge", "--chrome", out]) == 0
+        trace = json.load(open(out))
+        evs = trace["traceEvents"]
+        # one process lane per rank, named via metadata events
+        meta = [e for e in evs if e["ph"] == "M"]
+        assert {(e["pid"], e["args"]["name"]) for e in meta} \
+            == {(0, "rank 0"), (1, "rank 1")}
+        by_pid = {pid: [e for e in evs if e["ph"] == "X" and e["pid"] == pid]
+                  for pid in (0, 1)}
+        assert len(by_pid[0]) == 6 and len(by_pid[1]) == 6  # 3 steps x 2 ph
+        # spans align on each rank's own first t0 (host clocks are
+        # per-process): both lanes start at ts 0
+        assert min(e["ts"] for e in by_pid[0]) == 0.0
+        assert min(e["ts"] for e in by_pid[1]) == 0.0
+        # the lagging rank's dispatch spans are visibly longer
+        d0 = [e for e in by_pid[0] if e["name"] == "dispatch"][0]["dur"]
+        d1 = [e for e in by_pid[1] if e["name"] == "dispatch"][0]["dur"]
+        assert d1 == pytest.approx(d0 + 0.5e6)
+
+    def test_merge_flag_errors(self, tmp_path):
+        import tools.trace_report as tr
+
+        p0 = self._rank_events(tmp_path, 0)
+        p1 = self._rank_events(tmp_path, 1)
+        with pytest.raises(SystemExit):  # multi-file needs --merge
+            tr.main([p0, p1, "--chrome", str(tmp_path / "x.json")])
+        with pytest.raises(SystemExit):  # --merge needs --chrome
+            tr.main([p0, p1, "--merge"])
+
+    def test_merge_reads_rotated_streams(self, tmp_path):
+        """A size-capped (--events_max_mb) per-rank stream merges whole:
+        the stitcher feeds the lane builder, not just the live file."""
+        import tools.trace_report as tr
+
+        p0 = str(tmp_path / "r0.jsonl")
+        with obs_export.EventStream(p0, max_bytes=200) as es:
+            for i in range(3):
+                es.emit("epoch", epoch=i, step=i + 1, metrics={},
+                        throughput={}, guard={}, timeline={},
+                        step_spans=[{"t0": float(i), "data": 0.1,
+                                     "dispatch": 0.2, "total": 0.3}])
+        assert obs_export.list_segments(p0)
+        p1 = self._rank_events(tmp_path, 1)
+        out = str(tmp_path / "merged.json")
+        assert tr.main([p0, p1, "--merge", "--chrome", out]) == 0
+        evs = json.load(open(out))["traceEvents"]
+        lane0 = [e for e in evs if e["ph"] == "X" and e["pid"] == 0]
+        assert len(lane0) == 6  # all 3 rotated-away steps x 2 phases
+
+
+@pytest.mark.quick
+class TestPostmortemClassify:
+    """Verdict taxonomy priority order on synthetic bundles (the chaos
+    drill covers the real failure paths; these pin the tie-breaks)."""
+
+    def _bundle(self, rank, reason, *, step=None, error=None, rings=None):
+        from tpu_compressed_dp.obs.flight import CHANNELS, FLIGHT_SCHEMA
+
+        base = {ch: [] for ch in CHANNELS}
+        base.update(rings or {})
+        return {"v": FLIGHT_SCHEMA, "kind": "blackbox", "rank": rank,
+                "reason": reason, "step": step, "seq": 1, "capacity": 8,
+                "meta": {}, "error": error, "extra": None,
+                "counts": {"records": 1, "dumps": 1}, "rings": base}
+
+    def test_priority_order(self):
+        from tools.postmortem import classify
+
+        corrupt = self._bundle(1, "ckpt_corrupt", step=7,
+                               error={"message": "manifest sha mismatch"})
+        preempt = self._bundle(0, "preempt", step=7, error={"signum": 15})
+        peer = self._bundle(2, "peer_failed", step=7,
+                            error={"failed": [0]})
+        guard = self._bundle(3, "guard_exceeded", step=7, error={})
+        v = classify({0: preempt, 1: corrupt, 2: peer, 3: guard})
+        assert (v["kind"], v["rank"]) == ("corruption", 1)
+        v = classify({0: preempt, 2: peer, 3: guard})
+        assert (v["kind"], v["rank"]) == ("preempt", 0)
+        v = classify({2: peer, 3: guard})
+        assert (v["kind"], v["rank"]) == ("dead_peer", 0)
+        v = classify({3: guard})
+        assert (v["kind"], v["rank"]) == ("guard", -1)
+
+    def test_nan_names_injected_worker(self):
+        from tools.postmortem import classify
+
+        chaos_rec = {"kind": "nan", "seq": 0, "t": 0.0, "target": "grads",
+                     "every": 1, "worker": 2, "crash_at_step": -1}
+        b = self._bundle(0, "guard_exceeded", step=4, error={},
+                         rings={"chaos": [chaos_rec]})
+        v = classify({0: b})
+        assert (v["kind"], v["rank"], v["step"]) == ("nan", 2, 4)
+        assert "grads" in v["detail"]
+
+    def test_dead_peer_chaos_fallback_requires_armed_crash(self):
+        from tools.postmortem import classify
+
+        # survivors raised a bare PeerFailed with no .failed evidence
+        def peer(rings=None):
+            return self._bundle(0, "peer_failed", step=3, error={},
+                                rings=rings)
+
+        armed = {"kind": "crash", "seq": 0, "t": 0.0, "worker": 1,
+                 "crash_at_step": 3}
+        v = classify({0: peer({"chaos": [armed]})})
+        assert (v["kind"], v["rank"]) == ("dead_peer", 1)
+        # an unarmed config (crash_at_step=-1) must NOT name a scapegoat
+        unarmed = dict(armed, crash_at_step=-1)
+        v = classify({0: peer({"chaos": [unarmed]})})
+        assert (v["kind"], v["rank"]) == ("dead_peer", -1)
+
+    def test_straggler_fallback_and_unknown(self):
+        from tools.postmortem import STRAGGLER_FRAC, classify
+
+        def timing(step_s):
+            return {"timing": [{"kind": "span", "seq": i, "t": 0.0,
+                                "data": step_s / 2, "total": step_s}
+                               for i in range(4)]}
+
+        slow = self._bundle(1, "error", rings=timing(0.4))
+        fast = self._bundle(0, "error", rings=timing(0.1))
+        v = classify({0: fast, 1: slow})
+        assert (v["kind"], v["rank"]) == ("straggler", 1)
+        # under the skew floor the verdict stays unknown, not straggler
+        near = self._bundle(1, "error",
+                            rings=timing(0.1 * (1 + STRAGGLER_FRAC / 2)))
+        v = classify({0: fast, 1: near})
+        assert v["kind"] == "unknown"
+        assert classify({})["kind"] == "unknown"
+        assert classify({})["rank"] == -1
+
+    def test_merge_timeline_order_and_report(self):
+        from tools import postmortem as pm
+
+        b0 = self._bundle(
+            0, "peer_failed", step=2, error={"failed": [1]},
+            rings={"step": [{"kind": "metrics", "seq": 0, "t": 0.1,
+                             "step": 1},
+                            {"kind": "metrics", "seq": 1, "t": 0.2,
+                             "step": 2}],
+                   "fault": [{"kind": "peer_failed", "seq": 2, "t": 0.3}]})
+        b1 = self._bundle(
+            1, "chaos_crash", step=2, error={},
+            rings={"step": [{"kind": "metrics", "seq": 0, "t": 0.1,
+                             "step": 2}]})
+        merged = pm.merge_timeline({0: b0, 1: b1})
+        # stepped records first (step, rank, seq); step-less sort last
+        assert [(r["rank"], r.get("step")) for r in merged] \
+            == [(0, 1), (0, 2), (1, 2), (0, None)]
+        report = pm.render_report({0: b0, 1: b1})
+        assert report.splitlines()[0].startswith("postmortem: dead_peer")
+        assert "cross-rank timeline" in report
+        assert pm.verdict_line(pm.classify({0: b0, 1: b1})) \
+            == report.splitlines()[0]
+
+    def test_cli_json_and_missing_dir(self, tmp_path, capsys):
+        from tools import postmortem as pm
+        from tpu_compressed_dp.obs.flight import FlightRecorder
+        from tpu_compressed_dp.train.guard import GuardExceeded
+
+        assert pm.main([str(tmp_path / "empty")]) == 2
+        fl = FlightRecorder(rank=0, capacity=8, directory=str(tmp_path))
+        fl.note_step(3, {"loss": float("nan")})
+        fl.observe(GuardExceeded("skip streak 2 exceeded"), step=3)
+        capsys.readouterr()
+        assert pm.main([str(tmp_path), "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["verdict"]["kind"] == "guard"
+        assert payload["ranks"]["0"]["reason"] == "guard_exceeded"
+        assert payload["ranks"]["0"]["problems"] == []
+        assert payload["timeline"]

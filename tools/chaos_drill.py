@@ -1049,11 +1049,199 @@ def drill_fleet(mesh, *, policy="fold", elastic=True) -> Dict:
             "bitwise": True}
 
 
+def drill_forensics(mesh) -> Dict:
+    """Every injected failure leaves a valid black box and the postmortem
+    names the injected root cause — rank AND kind — from the bundles
+    alone; a clean run leaves none and the recorder never perturbs the
+    trajectory (bitwise with/without).
+
+    Four simulated ranks share one flight dir per case, each failure
+    raised through its REAL plane (guard wedge, mid-collective
+    ChaosCrash, self-SIGTERM preemption, manifest verification):
+
+      nan          chaos nan/grads worker=1 wedges the guard -> every
+                   rank dumps ``guard_exceeded``; verdict names worker 1
+      dead_peer    mid_collective kill of worker 2 -> the dying rank
+                   dumps ``chaos_crash``, survivors ``peer_failed``;
+                   verdict names rank 2
+      preempt      a real SIGTERM on rank 0 (chaos crash=preempt through
+                   PreemptionHandler) -> verdict ``preempt`` rank 0
+      corruption   one flipped payload byte + explicit-step restore ->
+                   ``ckpt_corrupt`` bundle; verdict ``corruption``
+    """
+    import time
+
+    from tpu_compressed_dp.obs.flight import (FlightRecorder, read_bundles,
+                                              validate_bundle)
+    from tpu_compressed_dp.parallel.dp import CompressionConfig
+    from tpu_compressed_dp.train.elastic import PeerFailed
+    from tpu_compressed_dp.train.guard import GuardConfig, GuardExceeded
+    from tpu_compressed_dp.utils.chaos import (ChaosConfig, ChaosCrash,
+                                               CrashInjector)
+    from tpu_compressed_dp.utils.checkpoint import (CheckpointCorrupt,
+                                                    Checkpointer)
+    from tpu_compressed_dp.utils.resilience import (Preempted,
+                                                    PreemptionHandler)
+
+    try:
+        from tools.postmortem import classify, merge_timeline
+    except ImportError:
+        from postmortem import classify, merge_timeline
+
+    comp = CompressionConfig(method="topk", ratio=0.25, error_feedback=True)
+    ranks = 4
+
+    def recorders(directory, chaos=None):
+        out = []
+        for r in range(ranks):
+            fl = FlightRecorder(rank=r, capacity=32, directory=directory,
+                                meta={"drill": "forensics"})
+            if chaos is not None:
+                fl.note_chaos(chaos)
+            out.append(fl)
+        return out
+
+    def check_bundles(directory, expect_ranks):
+        bundles = read_bundles(directory)
+        assert sorted(bundles) == sorted(expect_ranks), (
+            sorted(bundles), sorted(expect_ranks))
+        for r, b in bundles.items():
+            problems = validate_bundle(b)
+            assert not problems, (r, problems)
+        return bundles
+
+    verdicts = {}
+
+    # --- nan: chaos nan/grads on worker 1 wedges the guard everywhere
+    gcfg = GuardConfig(loss_scaling=False, max_consecutive_skips=2)
+    chaos = ChaosConfig(kind="nan", target="grads", every=1, worker=1)
+    state, step = _tiny_setup(mesh, comp, gcfg, chaos)
+    batch = _batch()
+    for i in range(4):
+        state, metrics = step(state, batch)
+    m = jax.device_get(metrics)
+    with tempfile.TemporaryDirectory() as td:
+        for fl in recorders(td, chaos):
+            fl.note_step(3, m)
+            try:
+                from tpu_compressed_dp.train.guard import check_guard_metrics
+                check_guard_metrics(m, gcfg, flight=fl)
+                raise AssertionError("guard did not wedge")
+            except GuardExceeded:
+                pass
+        bundles = check_bundles(td, range(ranks))
+        assert all(b["reason"] == "guard_exceeded"
+                   for b in bundles.values()), bundles
+        v = classify(bundles)
+        assert (v["kind"], v["rank"]) == ("nan", 1), v
+        assert merge_timeline(bundles), "empty merged timeline"
+        verdicts["nan"] = v
+
+    # --- dead_peer: mid-collective kill of worker 2; survivors raise
+    # PeerFailed naming it, the dying rank's own injector self-reports
+    chaos = ChaosConfig(crash_at_step=1, crash_mode="mid_collective",
+                        worker=2)
+    with tempfile.TemporaryDirectory() as td:
+        fls = recorders(td, chaos)
+        crash = CrashInjector(1, mode="mid_collective", worker=2)
+        crash.flight = fls[2]
+        try:
+            crash.check(1, phase="mid_collective")
+            raise AssertionError("injector did not fire")
+        except ChaosCrash as err:
+            fls[2].observe(err)
+        for r in (0, 1, 3):
+            fls[r].observe(PeerFailed((2,), step=1,
+                                      reason="gossip heartbeat stale"))
+        bundles = check_bundles(td, range(ranks))
+        assert bundles[2]["reason"] == "chaos_crash", bundles[2]
+        v = classify(bundles)
+        assert (v["kind"], v["rank"]) == ("dead_peer", 2), v
+        verdicts["dead_peer"] = v
+
+    # --- preempt: a REAL self-SIGTERM on rank 0, observed through the
+    # handler; peers raise PeerFailed — preempt must win the priority
+    chaos = ChaosConfig(crash_at_step=0, crash_mode="preempt")
+    with tempfile.TemporaryDirectory() as td:
+        fls = recorders(td, chaos)
+        crash = CrashInjector(0, mode="preempt")
+        crash.flight = fls[0]
+        handler = PreemptionHandler(log=lambda s: None).install()
+        assert handler.installed, "drill must run on the main thread"
+        try:
+            crash.check(0)          # self-SIGTERM, no raise
+            for _ in range(1000):   # signal lands within a few bytecodes
+                if handler.triggered:
+                    break
+                time.sleep(0.001)
+            handler.check(0)
+            raise AssertionError("preempt never fired")
+        except Preempted as err:
+            fls[0].observe(err)
+        finally:
+            handler.uninstall()
+        for r in (1, 2, 3):
+            fls[r].observe(PeerFailed((0,), step=0, reason="peer exited"))
+        bundles = check_bundles(td, range(ranks))
+        assert bundles[0]["reason"] == "preempt", bundles[0]
+        v = classify(bundles)
+        assert (v["kind"], v["rank"]) == ("preempt", 0), v
+        verdicts["preempt"] = v
+
+    # --- corruption: flipped payload byte + explicit-step restore — the
+    # manifest digest trips and the Checkpointer dumps before raising
+    state, step = _tiny_setup(mesh, comp, GuardConfig(loss_scaling=False),
+                              None)
+    with tempfile.TemporaryDirectory() as td:
+        ck_dir, fl_dir = os.path.join(td, "ck"), os.path.join(td, "fl")
+        fls = recorders(fl_dir)
+        ckpt = Checkpointer(ck_dir, flight=fls[0])
+        state, _ = step(state, batch)
+        ckpt.save(state, {"step_i": 1})
+        ckpt.close()
+        _flip_byte_in_step(ck_dir, 1)
+        # any structure-matching target works; the restore raises on the
+        # manifest digest before it rebuilds state
+        ckpt2 = Checkpointer(ck_dir, flight=fls[0])
+        try:
+            ckpt2.restore(state, step=1)
+            raise AssertionError("corrupt restore did not raise")
+        except CheckpointCorrupt:
+            pass
+        finally:
+            ckpt2.close()
+        bundles = check_bundles(fl_dir, [0])
+        assert bundles[0]["reason"] == "ckpt_corrupt", bundles[0]
+        v = classify(bundles)
+        assert v["kind"] == "corruption", v
+        verdicts["corruption"] = v
+
+    # --- control: a clean run dumps NOTHING, and recording is
+    # trajectory-neutral (bitwise with vs without a recorder).  Same
+    # compiled step + same start state for both trajectories — the only
+    # difference is the host-side recorder, which is the claim under test.
+    with tempfile.TemporaryDirectory() as td:
+        plain = observed = state
+        fls = recorders(td)
+        for i in range(3):
+            plain, _ = step(plain, batch)
+            observed, m2 = step(observed, batch)
+            for fl in fls:
+                fl.note_step(i, jax.device_get(m2))
+        for fl in fls:
+            fl.publish()  # phase profiles are NOT bundles
+        assert read_bundles(td) == {}, "clean run left blackbox bundles"
+        _assert_bitwise(_snap(plain), _snap(observed), "forensics control")
+    return {"verdicts": {k: v["kind"] for k, v in verdicts.items()},
+            "ranks": {k: v["rank"] for k, v in verdicts.items()},
+            "clean_bundles": 0, "bitwise": True}
+
+
 # -------------------------------------------------------------------- main
 
 QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery",
          "elastic_gossip", "elastic_remesh", "ckpt_preempt", "ckpt_corrupt",
-         "control_resume", "fleet"]
+         "control_resume", "fleet", "forensics"]
 FULL = QUICK + ["comp_hold", "ef_identity", "poison_control",
                 "skip_matrix", "ef_identity_sharded",
                 "elastic_readmit", "elastic_cascade", "elastic_matrix",
@@ -1139,7 +1327,7 @@ def main(argv=None) -> int:
                    help="tier-1 smoke subset (skip_consistency, loss_scale, "
                         "max_skips, crash_recovery, elastic_gossip, "
                         "elastic_remesh, ckpt_preempt, ckpt_corrupt, "
-                        "control_resume, fleet)")
+                        "control_resume, fleet, forensics)")
     p.add_argument("--drill", action="append", default=None,
                    help="run only the named drill(s)")
     p.add_argument("--list", action="store_true",

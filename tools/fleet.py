@@ -124,6 +124,11 @@ class SubprocessController(JobController):
             watermark = hb.get("last_good_step", hb.get("step"))
             if isinstance(watermark, (int, float)):
                 out["applied_updates"] = int(watermark)
+            skew = hb.get("straggler_skew_s")
+            if isinstance(skew, (int, float)):
+                # the flight recorder's live cross-rank step-time skew: the
+                # scheduler's straggler-eviction policy keys off this
+                out["straggler_skew_s"] = float(skew)
         if (out["exit_code"] is None
                 and time.time() - self.started_at.get(job_id, 0.0)
                 > self.grace_s):

@@ -14,10 +14,17 @@ Renders, from the records the harnesses emit through
     ui.perfetto.dev trace-event export** of the host timeline, one span
     per phase per step.
 
+With ``--merge``, takes MULTIPLE per-rank event streams and emits one
+cross-rank chrome://tracing export with a process lane per rank (lane
+index = argument position; reuses ``tools/postmortem.py``'s merge) — the
+visual the straggler gauges summarise to one number.
+
 Usage::
 
     python tools/trace_report.py events.jsonl
     python tools/trace_report.py events.jsonl --chrome trace.json
+    python tools/trace_report.py r0.jsonl r1.jsonl r2.jsonl \\
+        --merge --chrome merged.json
 """
 
 from __future__ import annotations
@@ -27,7 +34,7 @@ import json
 import sys
 from typing import Any, Dict, List, Optional
 
-from tpu_compressed_dp.obs.export import SCHEMA_VERSION, read_events
+from tpu_compressed_dp.obs.export import SCHEMA_VERSION, read_all_events
 from tpu_compressed_dp.obs.trace import percentile
 
 WINDOW_KINDS = ("epoch", "step")  # records that carry metrics + timeline
@@ -185,7 +192,13 @@ def render_schedule(path: str) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("events", help="JSONL event stream (harness --events)")
+    p.add_argument("events", nargs="+",
+                   help="JSONL event stream(s) (harness --events); more "
+                        "than one requires --merge")
+    p.add_argument("--merge", action="store_true",
+                   help="treat each events argument as one rank's stream "
+                        "and emit a cross-rank chrome trace with rank "
+                        "lanes (requires --chrome)")
     p.add_argument("--chrome", type=str, default=None,
                    help="write a chrome://tracing trace-event JSON here")
     p.add_argument("--json", action="store_true",
@@ -199,7 +212,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "trajectory (control_decision records; see "
                         "tools/control_report.py for the full report)")
     args = p.parse_args(argv)
-    events = read_events(args.events)
+    if args.merge:
+        if not args.chrome:
+            p.error("--merge requires --chrome OUT.json")
+        try:
+            from tools.postmortem import rank_lane_events
+        except ImportError:  # script mode: sys.path[0] is tools/
+            from postmortem import rank_lane_events
+        spans_by_rank: Dict[int, List[Dict[str, Any]]] = {}
+        for rank, path in enumerate(args.events):
+            evs = read_all_events(path)
+            check_schema(evs)
+            spans_by_rank[rank] = step_spans(evs)
+            print(f"rank {rank}: {len(spans_by_rank[rank])} step spans "
+                  f"({path})")
+        with open(args.chrome, "w") as f:
+            json.dump({"traceEvents": rank_lane_events(spans_by_rank),
+                       "displayTimeUnit": "ms"}, f)
+        print(f"cross-rank chrome trace: {args.chrome} "
+              "(load in chrome://tracing or ui.perfetto.dev)")
+        return 0
+    if len(args.events) > 1:
+        p.error("multiple event streams need --merge")
+    # a rotated stream (--events_max_mb) is stitched back together here
+    events = read_all_events(args.events[0])
     if args.json:
         payload = {"phase_breakdown": phase_breakdown(events),
                    "throughput": throughput_rows(events)}

@@ -23,6 +23,9 @@ Checks (see :func:`tpu_compressed_dp.utils.resilience.check_heartbeat`):
   * **checkpoint-stale** — heartbeat ``ckpt_age_s`` (plus the heartbeat's
     own age) exceeds ``--max_ckpt_age``: the run is making progress it
     could not recover — a crash now loses that much work.
+  * **straggler** — heartbeat ``straggler_skew_s`` (the flight recorder's
+    live cross-rank step-time skew) exceeds ``--max_straggler_skew``: one
+    rank is pacing the whole world's collectives.
 
 ``--relaunch`` is the acting half: it supervises the training command given
 after ``--``, runs the SAME health check every ``--interval`` seconds
@@ -89,6 +92,7 @@ def run_check(args) -> int:
         max_wedge_steps=args.max_wedge,
         min_steps_per_sec=args.min_step_rate,
         max_ckpt_age_s=args.max_ckpt_age,
+        max_straggler_skew_s=args.max_straggler_skew,
         hb=hb,
     )
     if problems:
@@ -295,6 +299,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="max seconds since the run's last durable "
                         "checkpoint (heartbeat ckpt_age_s + heartbeat age; "
                         "default: no checkpoint-staleness check)")
+    p.add_argument("--max_straggler_skew", type=float, default=None,
+                   help="max cross-rank step-time skew in seconds "
+                        "(heartbeat straggler_skew_s, from the flight "
+                        "recorder's live phase profiles; default: no "
+                        "straggler check)")
     p.add_argument("--interval", type=float, default=30.0,
                    help="relaunch mode: seconds between health checks")
     p.add_argument("--grace", type=float, default=120.0,

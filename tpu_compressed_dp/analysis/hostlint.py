@@ -69,6 +69,12 @@ REPLAY_DETERMINISTIC_MODULES = (
     "tpu_compressed_dp/fleet/spec.py",
     "tpu_compressed_dp/fleet/placement.py",
     "tpu_compressed_dp/fleet/scheduler.py",
+    # the flight recorder rides inside replay-deterministic callers (guard
+    # check, elastic failure handling) — its timestamps come from an
+    # injected clock; postmortem replays bundles offline and must order
+    # records by seq, never by wall reads of its own
+    "tpu_compressed_dp/obs/flight.py",
+    "tools/postmortem.py",
 )
 
 #: modules that write records other processes read over shared storage —
@@ -82,12 +88,16 @@ SHARED_DIR_MODULES = (
     # fleet queue/job/pool records: multi-process readers (operator CLI,
     # dashboards) over the shared fleet dir
     "tpu_compressed_dp/fleet/state.py",
+    # blackbox bundles + phase profiles: every rank writes, postmortem /
+    # peers / the watchdog read concurrently over the shared dir
+    "tpu_compressed_dp/obs/flight.py",
+    "tools/postmortem.py",
 )
 
 #: registry-governed stat-key families (TCDP103); literals shaped
 #: "<family>/<name>" with these families must be declared
 STAT_FAMILIES = ("comm", "guard", "elastic", "ckpt", "throughput", "time",
-                 "net", "control", "fleet")
+                 "net", "control", "fleet", "flight", "straggler")
 STAT_KEY_RE = re.compile(r"^(?:%s)/[a-z0-9_]+$" % "|".join(STAT_FAMILIES))
 
 _WALLCLOCK_CALLS = frozenset({

@@ -91,6 +91,7 @@ class _Job:
     restarts: int = 0
     resume: bool = False
     exit_code: Optional[int] = None
+    straggler_skew: float = 0.0  # last polled cross-rank step-time skew (s)
 
 
 class FleetScheduler:
@@ -106,6 +107,7 @@ class FleetScheduler:
                  wall: Callable[[], float] = time.time,
                  prom: bool = True,
                  max_restarts: int = 3,
+                 max_straggler_skew_s: Optional[float] = None,
                  log: Callable[[str], None] = print):
         self.fleet_dir = fleet_dir
         self.pool_size = int(pool_size)
@@ -114,6 +116,12 @@ class FleetScheduler:
         self._wall = wall
         self.prom = prom
         self.max_restarts = int(max_restarts)
+        #: evict-and-requeue a job whose polled cross-rank step-time skew
+        #: (``straggler_skew_s``, the flight recorder's live gauge surfaced
+        #: through the heartbeat) exceeds this bound — one slow host paces
+        #: every collective, so requeueing onto fresh devices usually beats
+        #: letting it drag the world (None = off)
+        self.max_straggler_skew_s = max_straggler_skew_s
         self.log = log
         self.pool = DevicePool(self.pool_size)
         self.jobs: Dict[str, _Job] = {}
@@ -179,13 +187,24 @@ class FleetScheduler:
             st = self.controller.poll(job.spec.job_id) or {}
             if "applied_updates" in st:
                 job.applied = int(st["applied_updates"])
+            if "straggler_skew_s" in st:
+                job.straggler_skew = float(st["straggler_skew_s"])
             rc = st.get("exit_code")
             if rc is None:
-                if st.get("healthy") is False:
-                    # wedged/stale per the heartbeat verdict: kill it and
-                    # requeue — the restart budget decides how long we try
+                straggling = (self.max_straggler_skew_s is not None
+                              and job.straggler_skew
+                              > self.max_straggler_skew_s)
+                if st.get("healthy") is False or straggling:
+                    # wedged/stale per the heartbeat verdict — or one rank
+                    # pacing the whole world past the straggler bound: kill
+                    # it and requeue — the restart budget decides how long
+                    # we try
                     rc = self.controller.evict(job.spec.job_id)
-                    self.log(f"fleet: {job.spec.job_id} unhealthy; killed "
+                    why = ("straggling "
+                           f"(skew {job.straggler_skew:.3g}s > "
+                           f"{self.max_straggler_skew_s:g}s)"
+                           if straggling else "unhealthy")
+                    self.log(f"fleet: {job.spec.job_id} {why}; killed "
                              f"(exit {rc})")
                     self._fail_or_requeue(job, rc)
                 continue
@@ -291,7 +310,8 @@ class FleetScheduler:
         return {"fleet/world": float(job.world),
                 "fleet/priority": float(job.spec.priority),
                 "fleet/applied_updates": float(job.applied),
-                "fleet/restarts": float(job.restarts)}
+                "fleet/restarts": float(job.restarts),
+                "straggler/skew_s": float(job.straggler_skew)}
 
     def _export(self) -> None:
         ts = self._wall()

@@ -39,8 +39,10 @@ from tpu_compressed_dp.harness.loop import (add_adaptive_args,
                                             build_elastic, build_robustness,
                                             control_summary,
                                             elastic_distributed_init,
-                                            job_scoped,
-                                            make_event_stream, make_heartbeat,
+                                            flight_update, job_scoped,
+                                            make_event_stream,
+                                            make_flight_recorder,
+                                            make_heartbeat,
                                             make_preemption, preempt_exit,
                                             profile_trace, prom_labels,
                                             train_epoch)
@@ -475,10 +477,19 @@ def run(args) -> dict:
         args, harness="dawn", network=args.network,
         method=args.method, compress=args.compress, mode=args.mode,
         transport=args.transport, batch_size=bs, devices=ndev, epochs=epochs)
+    flight = make_flight_recorder(
+        args, harness="dawn", network=args.network, method=args.method,
+        compress=args.compress, devices=ndev)
+    if flight is not None and chaos is not None:
+        flight.note_chaos(chaos)
+    if flight is not None and crash is not None:
+        crash.flight = flight
     if ckpt is not None:
         ckpt.events = events
+        ckpt.flight = flight
     preempt = make_preemption()
-    el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events)
+    el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events,
+                       flight=flight)
     if el is not None and rejoin is not None:
         # watchdog-relaunched host: adopt the running world's replicated
         # state from the re-elected coordinator's broadcast (EF rows start
@@ -547,11 +558,17 @@ def run(args) -> dict:
                         crash=crash, step_offset=int(state.step),
                         guard_cfg=guard_cfg, timeline=timeline, world=ndev,
                         pods=args.dp_pods,
-                        elastic=el, preempt=preempt,
+                        elastic=el, preempt=preempt, flight=flight,
                     )
             except Exception as err:
                 failure = el.failure_from(err) if el is not None else None
                 if failure is None:
+                    if flight is not None and not isinstance(
+                            err, resilience.Preempted):
+                        # unconverted failure about to unwind the run: the
+                        # dump here is the only evidence this rank leaves
+                        # (guard/ckpt/elastic dumps fire on their own paths)
+                        flight.observe(err, step=int(state.step))
                     raise
                 # Coordinated abort: survivors remesh from the last live
                 # TrainState (the pre-epoch buffers were donated away at
@@ -605,6 +622,9 @@ def run(args) -> dict:
                         hideable_fraction=hide_frac))
                 state = state.replace(control=new_control)
                 new_rung = int(new_control.rung)
+                if flight is not None:
+                    flight.note_control({"epoch": epoch, "rung": new_rung,
+                                         "applied": applied})
                 if new_rung != old_rung and controller.knob == "rank":
                     # PowerSGD rank switch: re-seat the warm q columns at
                     # the new rank so the next rung's step variant starts
@@ -625,6 +645,10 @@ def run(args) -> dict:
             thr = flops_mod.throughput_record(
                 fwd_flops, acc.steps / max(train_time, 1e-9),
                 examples_per_sec=examples / max(train_time, 1e-9))
+            # spans drain ONCE per epoch and fan out to every consumer
+            # (event stream, flight recorder's timing ring + phase profile)
+            spans = timeline.drain()
+            fgauges = flight_update(flight, spans=spans)
             if hb is not None:
                 # last_good_step: the watchdog's "is it making progress" signal
                 # — a wedged-but-alive run (skipping every step) beats but stops
@@ -640,6 +664,9 @@ def run(args) -> dict:
                     **({"elastic": el.metrics()} if el is not None else {}),
                     **(controller.heartbeat_fields(state.control)
                        if controller is not None else {}),
+                    **({"straggler_skew_s": fgauges["straggler/skew_s"],
+                        "straggler_rank": fgauges["straggler/rank"]}
+                       if "straggler/skew_s" in fgauges else {}),
                 )
             summary = {
                 "epoch": epoch + 1,
@@ -665,7 +692,7 @@ def run(args) -> dict:
                     throughput=thr, comm=comm_means, guard=guard_last,
                     control=control_stats,
                     timeline=timeline.snapshot(),
-                    step_spans=timeline.drain())
+                    step_spans=spans)
                 skipped = guard_last.get("guard/skipped", 0.0)
                 if skipped > prev_skipped:
                     events.emit("guard", epoch=epoch + 1,
@@ -677,7 +704,8 @@ def run(args) -> dict:
                      **thr, **comm_means, **guard_last, **control_stats,
                      **timeline.snapshot(),
                      **(ckpt.metrics() if ckpt is not None else {}),
-                     **(el.metrics() if el is not None else {})},
+                     **(el.metrics() if el is not None else {}),
+                     **fgauges},
                     job_scoped(args, args.prom),
                     labels=prom_labels(args, harness="dawn"))
             if rank0:
@@ -697,7 +725,8 @@ def run(args) -> dict:
         # runs — ckpt.close after the emergency save is a no-op drain)
         state = getattr(err, "elastic_state", state)
         raise preempt_exit(err, ckpt=ckpt, state=state,
-                           meta={"epoch": epoch - 1}, events=events) from None
+                           meta={"epoch": epoch - 1}, events=events,
+                           flight=flight) from None
     finally:
         preempt.uninstall()
         tb.close()

@@ -54,8 +54,10 @@ from tpu_compressed_dp.harness.loop import (
     build_robustness,
     control_summary,
     elastic_distributed_init,
+    flight_update,
     job_scoped,
     make_event_stream,
+    make_flight_recorder,
     make_heartbeat,
     make_preemption,
     prom_labels,
@@ -476,10 +478,19 @@ def run(args) -> Dict[str, float]:
         args, harness="imagenet", arch=args.arch, method=args.method,
         compress=args.compress, mode=args.mode, transport=args.transport,
         devices=ndev, epochs=epochs)
+    flight = make_flight_recorder(
+        args, harness="imagenet", arch=args.arch, method=args.method,
+        compress=args.compress, devices=ndev)
+    if flight is not None and chaos is not None:
+        flight.note_chaos(chaos)
+    if flight is not None and crash is not None:
+        crash.flight = flight
     if ckpt is not None:
         ckpt.events = events   # save/rollback records on the run's stream
+        ckpt.flight = flight
     preempt = make_preemption()
-    el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events)
+    el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events,
+                       flight=flight)
     if el is not None and rejoin is not None:
         # watchdog-relaunched host: the surviving world is mid-training.
         # Adopt its replicated state (broadcast from the re-elected
@@ -573,10 +584,16 @@ def run(args) -> Dict[str, float]:
                                                  guard_cfg=guard_cfg,
                                                  timeline=timeline,
                                                  elastic=el,
-                                                 preempt=preempt)
+                                                 preempt=preempt,
+                                                 flight=flight)
             except Exception as err:  # noqa: BLE001 - converted or re-raised
                 failure = el.failure_from(err) if el is not None else None
                 if failure is None:
+                    if flight is not None and not isinstance(
+                            err, resilience.Preempted):
+                        # unconverted failure about to unwind the run: the
+                        # dump here is the only evidence this rank leaves
+                        flight.observe(err, step=int(state.step))
                     raise
                 # coordinated abort: remesh from the last live TrainState
                 # (donation consumed the pre-epoch buffers; run_train_epoch
@@ -603,6 +620,10 @@ def run(args) -> Dict[str, float]:
                     train_step = train_step_for(active_comp())
                     eval_step = make_eval_step(apply_fn, mesh)
                     fwd_cache.clear()
+            # spans drain ONCE per epoch and fan out to every consumer
+            # (event stream, flight recorder's timing ring + phase profile)
+            spans = timeline.drain()
+            fgauges = flight_update(flight, spans=spans)
             if hb is not None:
                 hb.update(
                     step=int(state.step),
@@ -617,6 +638,9 @@ def run(args) -> Dict[str, float]:
                     # last finished epoch's per-fabric billing: lets a
                     # fleet poll see the DCN demand without scraping prom
                     **({"net": fabric_g} if fabric_g else {}),
+                    **({"straggler_skew_s": fgauges["straggler/skew_s"],
+                        "straggler_rank": fgauges["straggler/rank"]}
+                       if "straggler/skew_s" in fgauges else {}),
                 )
             train_time = timer()
             if controller is not None:
@@ -645,6 +669,9 @@ def run(args) -> Dict[str, float]:
                         hideable_fraction=hide_frac))
                 state = state.replace(control=new_control)
                 new_rung = int(new_control.rung)
+                if flight is not None:
+                    flight.note_control({"epoch": epoch, "rung": new_rung,
+                                         "applied": applied})
                 if new_rung != old_rung:
                     if controller.knob == "rank":
                         # PowerSGD rank switch: re-seat warm q columns at
@@ -709,7 +736,7 @@ def run(args) -> Dict[str, float]:
                     throughput=thr, comm=comm_means, guard=guard_last,
                     control=control_stats,
                     timeline=timeline.snapshot(),
-                    step_spans=timeline.drain())
+                    step_spans=spans)
                 skipped = guard_last.get("guard/skipped", 0.0)
                 if skipped > prev_skipped:
                     events.emit("guard", epoch=epoch, step=int(state.step),
@@ -721,7 +748,8 @@ def run(args) -> Dict[str, float]:
                      **fabric_g,
                      **guard_last, **control_stats, **timeline.snapshot(),
                      **(ckpt.metrics() if ckpt is not None else {}),
-                     **(el.metrics() if el is not None else {})},
+                     **(el.metrics() if el is not None else {}),
+                     **fgauges},
                     job_scoped(args, args.prom),
                     labels=prom_labels(args, harness="imagenet"))
             # tensorboard: x-axis = cumulative examples (`logger.py:24-34`);
@@ -764,7 +792,7 @@ def run(args) -> Dict[str, float]:
         state = getattr(err, "elastic_state", state)
         raise preempt_exit(err, ckpt=ckpt, state=state,
                            meta={"epoch": epoch - 1},
-                           events=events) from None
+                           events=events, flight=flight) from None
     finally:
         preempt.uninstall()
         tb.close()

@@ -323,8 +323,9 @@ def run(args) -> Dict[str, float]:
     from tpu_compressed_dp.utils.meters import GuardMeter, per_chip_comm_bytes
 
     guard_meter = GuardMeter()
-    from tpu_compressed_dp.harness.loop import (job_scoped,
+    from tpu_compressed_dp.harness.loop import (flight_update, job_scoped,
                                                 make_event_stream,
+                                                make_flight_recorder,
                                                 make_heartbeat,
                                                 make_preemption,
                                                 preempt_exit, profile_trace,
@@ -340,8 +341,16 @@ def run(args) -> Dict[str, float]:
         method=comp.method or "none", compress=args.compress, mode=args.mode,
         transport=args.transport, seq_len=args.seq_len,
         global_batch=args.global_batch, steps=args.steps)
+    flight = make_flight_recorder(
+        args, harness="lm", preset=args.preset, mesh=mesh_str,
+        method=comp.method or "none")
+    if flight is not None and chaos is not None:
+        flight.note_chaos(chaos)
+    if flight is not None and crash is not None:
+        crash.flight = flight
     if ckpt is not None:
         ckpt.events = events   # save/rollback records on the run's stream
+        ckpt.flight = flight
     preempt = make_preemption()
     if getattr(args, "elastic", False) and pipelined:
         # dp x sp and dp x tp remesh by deleting the dead DATA row (the
@@ -356,7 +365,7 @@ def run(args) -> Dict[str, float]:
 
     el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events,
                        place=lambda s, m: place_lm_state(s, cfg, comp, m),
-                       ef_axes=("data", "seq"))
+                       flight=flight, ef_axes=("data", "seq"))
     if el is not None and rejoin is not None:
         # watchdog-relaunched host: adopt the running world's replicated
         # state from the re-elected coordinator's broadcast (EF rows start
@@ -442,12 +451,18 @@ def run(args) -> Dict[str, float]:
                 if (step_i + 1) % args.log_every == 0 or step_i == args.steps - 1:
                     m = (el.bounded_get(metrics, step=step_i + 1)
                          if el is not None else jax.device_get(metrics))
+                    # spans drain ONCE per window and fan out to every
+                    # consumer; the flight rings fill BEFORE the wedge check
+                    # so a GuardExceeded dump carries the streak history
+                    spans = timeline.drain()
+                    fgauges = flight_update(flight, step=step_i + 1,
+                                            metrics=m, spans=spans)
                     if guard_cfg is not None:
                         # wedge check at log cadence (detection latency = log_every)
                         from tpu_compressed_dp.train.guard import check_guard_metrics
 
                         guard_meter.update(m, step_i + 1)
-                        check_guard_metrics(m, guard_cfg)
+                        check_guard_metrics(m, guard_cfg, flight=flight)
                     if hb is not None:
                         hb.update(
                             step=step_i + 1,
@@ -459,6 +474,9 @@ def run(args) -> Dict[str, float]:
                             **({"elastic": el.metrics()} if el is not None else {}),
                             **(controller.heartbeat_fields(state.control)
                                if controller is not None else {}),
+                            **({"straggler_skew_s": fgauges["straggler/skew_s"],
+                                "straggler_rank": fgauges["straggler/rank"]}
+                               if "straggler/skew_s" in fgauges else {}),
                         )
                     steps_timed = step_i + 1 - timed_from
                     tokens_done = steps_timed * rows * args.seq_len
@@ -531,6 +549,11 @@ def run(args) -> Dict[str, float]:
                                     compute_ms=wall_ms,
                                     hideable_fraction=hide_frac))
                             state = state.replace(control=new_control)
+                            if flight is not None:
+                                flight.note_control(
+                                    {"step": step_i + 1,
+                                     "rung": int(new_control.rung),
+                                     "applied": applied})
                             if int(new_control.rung) != old_rung:
                                 # trace-cached rung switch: takes effect at
                                 # the next step dispatch
@@ -546,7 +569,7 @@ def run(args) -> Dict[str, float]:
                             throughput=thr, comm=comm_m, guard=guard_last,
                             control=control_stats,
                             timeline=timeline.snapshot(),
-                            step_spans=timeline.drain())
+                            step_spans=spans)
                         # delta-gate on the cumulative counter: one guard event
                         # per window that actually skipped, not one per window
                         # forever after the first skip
@@ -560,7 +583,8 @@ def run(args) -> Dict[str, float]:
                              **thr, **comm_m, **guard_last, **control_stats,
                              **timeline.snapshot(),
                              **(ckpt.metrics() if ckpt is not None else {}),
-                             **(el.metrics() if el is not None else {})},
+                             **(el.metrics() if el is not None else {}),
+                             **fgauges},
                             job_scoped(args, args.prom),
                             labels=prom_labels(args, harness="lm"))
                     table.append(summary)
@@ -586,6 +610,11 @@ def run(args) -> Dict[str, float]:
             except Exception as err:  # noqa: BLE001 - converted or re-raised
                 failure = el.failure_from(err) if el is not None else None
                 if failure is None:
+                    if flight is not None and not isinstance(
+                            err, resilience.Preempted):
+                        # unconverted failure about to unwind the run: the
+                        # dump here is the only evidence this rank leaves
+                        flight.observe(err, step=step_i)
                     raise
                 # coordinated abort + remesh.  Granularity is one step: a
                 # pre-dispatch detection (gossip poll) retries the same
@@ -621,7 +650,7 @@ def run(args) -> Dict[str, float]:
         state = getattr(err, "elastic_state", state)
         raise preempt_exit(err, ckpt=ckpt, state=state,
                            meta={"step": int(state.step)},
-                           events=events) from None
+                           events=events, flight=flight) from None
     finally:
         preempt.uninstall()
         prof.close()

@@ -27,7 +27,8 @@ __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
            "add_telemetry_args", "job_scoped", "prom_labels",
            "add_checkpoint_args", "build_robustness",
            "build_control", "build_elastic", "elastic_distributed_init",
-           "make_heartbeat", "make_event_stream", "make_preemption",
+           "make_heartbeat", "make_event_stream", "make_flight_recorder",
+           "flight_update", "make_preemption",
            "preempt_exit", "profile_trace"]
 
 
@@ -69,6 +70,20 @@ def add_telemetry_args(p) -> None:
                         "and labels the Prometheus exposition job=\"<id>\", "
                         "so jobs sharing one collector dir never clobber "
                         "each other")
+    p.add_argument("--events_max_mb", type=float, default=0.0,
+                   help="rotate the --events JSONL when the live file "
+                        "would cross this many MB (atomic rename to "
+                        "<path>.<seg>; records carry their segment index; "
+                        "0 = unbounded)")
+    p.add_argument("--flight_dir", type=str, default=None,
+                   help="shared dir for the per-rank flight recorder "
+                        "(obs/flight.py): ring-buffered telemetry, "
+                        "blackbox.rank<R>.json dumps on failure paths, "
+                        "live straggler/* gauges — feed the dir to "
+                        "tools/postmortem.py after a crash")
+    p.add_argument("--flight_capacity", type=int, default=256,
+                   help="flight-recorder ring capacity per channel "
+                        "(memory is O(channels x capacity))")
 
 
 def add_topology_args(p) -> None:
@@ -140,7 +155,44 @@ def make_event_stream(args, **meta):
 
     if getattr(args, "job_id", None):
         meta = dict(meta, job=args.job_id)
-    return EventStream(job_scoped(args, args.events), meta=dict(meta))
+    max_mb = getattr(args, "events_max_mb", 0.0) or 0.0
+    return EventStream(job_scoped(args, args.events), meta=dict(meta),
+                       max_bytes=int(max_mb * 1e6) if max_mb > 0 else None)
+
+
+def make_flight_recorder(args, **meta):
+    """The harnesses' ``--flight_dir`` setup: a per-rank
+    :class:`~tpu_compressed_dp.obs.flight.FlightRecorder` (or None).  EVERY
+    rank gets one — unlike the event stream, the whole point is per-rank
+    evidence — writing bundles/profiles into the job-scoped shared dir."""
+    if not getattr(args, "flight_dir", None):
+        return None
+    from tpu_compressed_dp.obs.flight import FlightRecorder
+
+    directory = getattr(args, "flight_dir")
+    if getattr(args, "job_id", None):
+        directory = os.path.join(directory, args.job_id)
+        meta = dict(meta, job=args.job_id)
+    return FlightRecorder(rank=jax.process_index(),
+                          capacity=getattr(args, "flight_capacity", 256),
+                          directory=directory, meta=dict(meta))
+
+
+def flight_update(flight, *, step=None, metrics=None, spans=None):
+    """Per-epoch/window flight upkeep: feed the drained timeline spans and
+    the window's fetched metrics into the rings, publish this rank's phase
+    profile, and return the gauges (``flight/*`` counters + the live
+    cross-rank ``straggler/*``) for the heartbeat/Prometheus payloads.
+    ``{}`` when the recorder is off — callers can merge unconditionally."""
+    if flight is None:
+        return {}
+    if spans:
+        flight.note_spans(spans)
+    if step is not None:
+        flight.note_step(step, metrics or {})
+    gauges = dict(flight.metrics())
+    gauges.update(flight.publish())
+    return gauges
 
 
 def add_robustness_args(p, *, check_note: str) -> None:
@@ -306,11 +358,12 @@ def make_preemption(log=print):
 
 
 def preempt_exit(err, *, ckpt=None, state=None, meta=None, events=None,
-                 log=print):
+                 flight=None, log=print):
     """The harnesses' common preemption epilogue: drain any in-flight async
     checkpoint write (ignoring its failure — the emergency save is about to
     supersede it), cut a SYNCHRONOUS emergency checkpoint, emit a
-    ``preempt`` event, and return the ``SystemExit`` carrying
+    ``preempt`` event, dump the flight-recorder black box, and return the
+    ``SystemExit`` carrying
     :data:`~tpu_compressed_dp.utils.resilience.PREEMPT_EXIT` for the caller
     to raise — the distinct code ``tools/watchdog.py --relaunch`` respawns
     immediately on (no backoff burn)."""
@@ -323,6 +376,11 @@ def preempt_exit(err, *, ckpt=None, state=None, meta=None, events=None,
             saved = ckpt.save(state, {**(meta or {}), "emergency": True})
         except Exception as save_err:
             log(f"preempt: emergency checkpoint FAILED: {save_err!r}")
+    if flight is not None:
+        # last write before the process dies: the postmortem's only
+        # evidence that this rank exited on a reclaim, not a crash
+        flight.observe(err, step=getattr(err, "step", None),
+                       saved_step=saved)
     if events is not None:
         try:
             events.emit("preempt", step=getattr(err, "step", None),
@@ -364,7 +422,7 @@ def build_robustness(args, dtype):
 
 
 def build_elastic(args, mesh, *, chaos=None, crash=None, events=None,
-                  place=None, ef_axes=("data",)):
+                  place=None, flight=None, ef_axes=("data",)):
     """Resolve the ``--elastic*`` CLI surface into a started
     :class:`~tpu_compressed_dp.train.elastic.ElasticRuntime` (or None).
 
@@ -406,7 +464,8 @@ def build_elastic(args, mesh, *, chaos=None, crash=None, events=None,
             rendezvous = Rendezvous(cfg.gossip_dir, cfg.rank)
     return ElasticRuntime(cfg, mesh, chaos=chaos, gossip=gossip,
                           events=events, place=place, crash=crash,
-                          rendezvous=rendezvous, ef_axes=tuple(ef_axes))
+                          rendezvous=rendezvous, flight=flight,
+                          ef_axes=tuple(ef_axes))
 
 
 def elastic_distributed_init(args):
@@ -488,7 +547,7 @@ def pad_batch(batch: Dict[str, np.ndarray], size: int) -> Dict[str, np.ndarray]:
 
 def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
                     *, crash=None, step_offset: int = 0, guard_cfg=None,
-                    timeline=None, elastic=None, preempt=None,
+                    timeline=None, elastic=None, preempt=None, flight=None,
                     ) -> Tuple[TrainState, MetricAccumulator]:
     # Metrics stay on device until the epoch ends: a per-step float() would
     # block host batch prep on the device and serialize the pipeline (JAX's
@@ -558,10 +617,16 @@ def run_train_epoch(train_step, state: TrainState, batches: Iterable[Dict],
         fetched = jax.device_get(step_metrics)
     for metrics in fetched:
         acc.update(metrics)
+    if flight is not None:
+        # ring the fetched (host) metrics BEFORE the guard inspects them:
+        # when the wedge check raises, the streak history that tripped it is
+        # already in the black box (O(capacity) host dicts, no device work)
+        for j, metrics in enumerate(fetched):
+            flight.note_step(step_offset + j, metrics)
     if guard_cfg is not None and fetched:
         from tpu_compressed_dp.train.guard import check_guard_metrics
 
-        check_guard_metrics(fetched[-1], guard_cfg)
+        check_guard_metrics(fetched[-1], guard_cfg, flight=flight)
     return state, acc
 
 
@@ -598,6 +663,7 @@ def train_epoch(
     pods: int = 1,
     elastic=None,
     preempt=None,
+    flight=None,
 ) -> Tuple[TrainState, Dict[str, float], MetricAccumulator]:
     """One train + eval pass with the reference's epoch-summary shape
     (`core.py:324-331`).  ``crash``/``step_offset``/``guard_cfg``/
@@ -610,7 +676,7 @@ def train_epoch(
     state, train_acc = run_train_epoch(
         train_step, state, train_batches, crash=crash,
         step_offset=step_offset, guard_cfg=guard_cfg, timeline=timeline,
-        elastic=elastic, preempt=preempt)
+        elastic=elastic, preempt=preempt, flight=flight)
     train_time = timer()
     test_stats = run_eval(eval_step, state, test_batches, batch_size)
     test_time = timer(test_time_in_total)

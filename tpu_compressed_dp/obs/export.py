@@ -17,14 +17,16 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from tpu_compressed_dp.obs import registry
 
-__all__ = ["SCHEMA_VERSION", "EventStream", "read_events",
-           "write_prometheus", "telemetry_snapshot", "job_scoped_path"]
+__all__ = ["SCHEMA_VERSION", "EventStream", "read_events", "read_all_events",
+           "list_segments", "write_prometheus", "telemetry_snapshot",
+           "job_scoped_path"]
 
 #: Bump when a record's field meaning changes incompatibly; consumers
 #: (trace_report, watchdog, tests) check it before interpreting fields.
@@ -44,24 +46,50 @@ class EventStream:
     write+flush is serialised under a lock and records stay whole-line.  An
     ``emit`` racing (or after) ``close`` is dropped silently — a late
     background commit must not crash the run epilogue.
+
+    ``max_bytes`` bounds the LIVE file: when appending the next record
+    would cross it, the file rotates to ``<path>.<seg:04d>`` via an atomic
+    ``os.replace`` (a tailing reader sees either the old whole file or the
+    fresh one, never a truncation) and the stream reopens empty.  Every
+    record carries its segment index as ``seg``, so consumers can stitch
+    rotated segments back into one ordered stream
+    (:func:`read_all_events`); on resume, numbering continues after the
+    segments already on disk.  ``max_bytes=None`` (the default) keeps the
+    historic unbounded single-file behaviour.
     """
 
-    def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None):
+    def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None,
+                 *, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = max_bytes
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
+        self._seg = len(list_segments(path))
         self._f = open(path, "a")
         self._closed = False
         self.emit("run_start", **(meta or {}))
 
+    def _rotate_locked(self) -> None:
+        # caller holds self._lock
+        self._f.close()
+        os.replace(self.path, f"{self.path}.{self._seg:04d}")
+        self._seg += 1
+        self._f = open(self.path, "a")
+
     def emit(self, kind: str, **fields: Any) -> None:
         rec = {"v": SCHEMA_VERSION, "kind": kind, "ts": time.time(), **fields}
-        line = json.dumps(rec) + "\n"
         with self._lock:
             if self._closed:
                 return
+            rec["seg"] = self._seg
+            line = json.dumps(rec) + "\n"
+            if (self.max_bytes is not None and self._f.tell() > 0
+                    and self._f.tell() + len(line) > self.max_bytes):
+                self._rotate_locked()
+                rec["seg"] = self._seg
+                line = json.dumps(rec) + "\n"
             self._f.write(line)
             self._f.flush()
 
@@ -107,6 +135,33 @@ def read_events(path: str) -> List[Dict[str, Any]]:
             line = line.strip()
             if line:
                 out.append(json.loads(line))
+    return out
+
+
+def list_segments(path: str) -> List[str]:
+    """Rotated segment files for a stream (``<path>.0000``, ...),
+    ascending by segment index."""
+    d, base = os.path.split(path)
+    seg_re = re.compile(re.escape(base) + r"\.(\d{4})$")
+    try:
+        names = os.listdir(d or ".")
+    except OSError:
+        return []
+    found = []
+    for name in names:
+        m = seg_re.match(name)
+        if m:
+            found.append((int(m.group(1)), os.path.join(d, name)))
+    return [p for _, p in sorted(found)]
+
+
+def read_all_events(path: str) -> List[Dict[str, Any]]:
+    """Events across every rotated segment plus the live file, stitched in
+    segment order — the reader-side pair of ``EventStream(max_bytes=...)``."""
+    out: List[Dict[str, Any]] = []
+    for p in list_segments(path) + [path]:
+        if os.path.exists(p):
+            out.extend(read_events(p))
     return out
 
 

@@ -272,6 +272,26 @@ declare("fleet/readmits", COUNTER, "jobs", "max", "host",
         "through the elastic readmit barrier")
 
 
+# --- flight recorder + live straggler detection (obs/flight.py;
+#     host-side, observation-only — per-rank values) ---------------------
+declare("flight/records", COUNTER, "records", "max", "host",
+        "records accepted into the flight recorder's ring buffers over "
+        "the process lifetime")
+declare("flight/dumps", COUNTER, "bundles", "max", "host",
+        "blackbox bundle dumps committed to the shared dir (>0 means a "
+        "failure path fired)")
+declare("flight/last_dump_step", GAUGE, "step", "max", "host",
+        "global step of the most recent blackbox dump (-1 = none)")
+declare("straggler/skew_s", GAUGE, "s", "max", "host",
+        "cross-rank skew of the mean host step time (slowest minus "
+        "fastest rank, from the shared flight phase profiles)")
+declare("straggler/rank", GAUGE, "rank", "max", "host",
+        "the slowest rank by mean host step time (-1 when fewer than "
+        "two ranks report)")
+declare("straggler/frac", GAUGE, "frac", "max", "host",
+        "straggler skew relative to the fastest rank's mean step time")
+
+
 def canonical(key: str) -> str:
     """Map a raw engine stat key to its canonical registry name.
 

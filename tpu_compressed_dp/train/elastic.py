@@ -668,6 +668,7 @@ class ElasticRuntime:
                  place: Optional[Callable[[Any, Any], Any]] = None,
                  crash=None, rendezvous=None,
                  ef_axes: Tuple[str, ...] = (DATA_AXIS,),
+                 flight=None,
                  log: Callable[[str], None] = print):
         _mesh_grid(mesh)  # validates the mesh shape up front
         self.cfg = cfg
@@ -675,6 +676,10 @@ class ElasticRuntime:
         self.chaos = chaos
         self.gossip = gossip
         self.events = events
+        # flight recorder (obs/flight.py): peer failures dump a blackbox
+        # bundle, remesh/cascade/readmit transitions land in its elastic
+        # ring — observation only, never load-bearing
+        self.flight = flight
         # how to re-place a migrated state on a new mesh; the CNN default
         # is the TrainState's own sharding rule, the LM harness passes its
         # place_lm_state closure
@@ -778,6 +783,11 @@ class ElasticRuntime:
 
         if not failure.failed:
             raise failure
+        # dump the blackbox NOW, while the evidence is fresh: even though
+        # this handler usually recovers, the dead peer's why/when must
+        # survive a cascade that kills us mid-remesh
+        if self.flight is not None:
+            self.flight.observe(failure, step=failure.step)
         if self.rendezvous is not None and jax.process_count() > 1:
             return self._handle_failure_multiprocess(state, failure)
         failed = {int(f) for f in failure.failed}
@@ -786,11 +796,14 @@ class ElasticRuntime:
         while True:
             new_world = self.world - len(failed)
             if new_world < self.cfg.min_world:
-                raise PeerFailed(
+                err = PeerFailed(
                     sorted(failed), step=failure.step,
                     reason=(f"{reason}; surviving world {new_world} "
                             f"below min_world {self.cfg.min_world} — "
                             "not remeshing"))
+                if self.flight is not None:
+                    self.flight.observe(err, step=failure.step)
+                raise err
             new_mesh, removed = surviving_mesh(self.mesh, sorted(failed))
             new_state, dropped = shrink_state(
                 state, sorted(failed), policy=self.cfg.ef_policy,
@@ -820,6 +833,11 @@ class ElasticRuntime:
                                 "remesh_cascade", step=failure.step,
                                 failed=sorted(failed),
                                 added=sorted(extra))
+                        if self.flight is not None:
+                            self.flight.record(
+                                "elastic", "remesh_cascade",
+                                step=failure.step, failed=sorted(failed),
+                                added=sorted(extra))
                         continue
             break
         state = self._place(new_state, new_mesh)
@@ -845,6 +863,13 @@ class ElasticRuntime:
                 dropped_ef_norm=float(dropped),
                 latency_ms=self.remesh_latency_ms,
                 remesh_ms=self.remesh_ms)
+        if self.flight is not None:
+            self.flight.record(
+                "elastic", "remesh", step=failure.step,
+                failed=sorted(failed), world=new_world,
+                ef_policy=self.cfg.ef_policy,
+                dropped_ef_norm=float(dropped),
+                latency_ms=self.remesh_latency_ms)
         return state
 
     # -- re-admission ----------------------------------------------------
@@ -873,6 +898,9 @@ class ElasticRuntime:
                   f"world {self.world}")
         if self.events is not None:
             self.events.emit("readmit", ranks=ranks, world=self.world)
+        if self.flight is not None:
+            self.flight.record("elastic", "readmit", ranks=ranks,
+                               world=self.world)
         return state
 
     @property
@@ -1008,6 +1036,12 @@ class ElasticRuntime:
                 dropped_ef_norm=float("nan"),
                 latency_ms=self.remesh_latency_ms,
                 remesh_ms=self.remesh_ms)
+        if self.flight is not None:
+            self.flight.record(
+                "elastic", "remesh", step=failure.step,
+                failed=sorted(dead), world=new_world,
+                epoch=decision.epoch, ef_policy="drop",
+                latency_ms=self.remesh_latency_ms)
         return state
 
     def rejoin_barrier(self, state):
@@ -1064,6 +1098,9 @@ class ElasticRuntime:
         if self.events is not None:
             self.events.emit("readmit", ranks=ready, world=self.world,
                              epoch=decision.epoch)
+        if self.flight is not None:
+            self.flight.record("elastic", "readmit", ranks=ready,
+                               world=self.world, epoch=decision.epoch)
         return state, True
 
     def join_world(self, state, decision):

@@ -275,7 +275,7 @@ def guard_metrics(gs: GuardState) -> Dict[str, Array]:
 
 
 def check_guard_metrics(metrics: Dict[str, Any],
-                        cfg: GuardConfig) -> None:
+                        cfg: GuardConfig, *, flight=None) -> None:
     """Host-side wedge detector: raise :class:`GuardExceeded` when the
     consecutive-skip streak has passed ``max_consecutive_skips``.
 
@@ -286,14 +286,24 @@ def check_guard_metrics(metrics: Dict[str, Any],
     every-step overhead; a wedged run burning one extra epoch of skips is
     the cheaper failure mode, and the raise still lands inside
     ``run_with_recovery``'s retry loop.
+
+    ``flight`` (a :class:`~tpu_compressed_dp.obs.flight.FlightRecorder`)
+    dumps this rank's blackbox bundle before the raise — the wedge
+    evidence (the guard ring's streak history, the chaos arm) would
+    otherwise die with the process.
     """
     streak = metrics.get("guard/skip_streak")
     if streak is None:
         return
     if float(streak) > cfg.max_consecutive_skips:
-        raise GuardExceeded(
+        err = GuardExceeded(
             f"step guard: {int(float(streak))} consecutive nonfinite steps "
             f"(> max_consecutive_skips={cfg.max_consecutive_skips}); "
             f"loss_scale={float(metrics.get('guard/loss_scale', -1.0)):g}, "
             f"last_good_step={int(float(metrics.get('guard/last_good_step', -1)))}"
         )
+        if flight is not None:
+            flight.observe(
+                err,
+                step=int(float(metrics.get("guard/last_good_step", -1))))
+        raise err

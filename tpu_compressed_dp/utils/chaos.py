@@ -238,6 +238,21 @@ class CrashInjector:
         self.mode = mode
         self.worker = int(worker)
         self.fired = False
+        #: optional FlightRecorder (obs/flight.py): the injector notes the
+        #: injection into the chaos ring the instant it fires — for the
+        #: preempt mode this is the ONLY record the dying process gets to
+        #: make before the SIGTERM lands
+        self.flight = None
+
+    def _note_fired(self, step: int) -> None:
+        if self.flight is None:
+            return
+        try:
+            self.flight.record("chaos", "crash_fired", step=int(step),
+                               mode=self.mode, worker=self.worker,
+                               crash_at_step=self.crash_at_step)
+        except Exception:
+            pass  # forensics must never alter the injected failure
 
     def check(self, step: int, phase: str = "step") -> None:
         if self.mode == "preempt":
@@ -248,6 +263,7 @@ class CrashInjector:
                     and self.crash_at_step >= 0
                     and int(step) >= self.crash_at_step):
                 self.fired = True
+                self._note_fired(step)
                 os.kill(os.getpid(), signal.SIGTERM)
             return
         # >= not ==: epoch-granular callers (the CNN harnesses check once
@@ -257,6 +273,7 @@ class CrashInjector:
                 and self.crash_at_step >= 0
                 and int(step) >= self.crash_at_step):
             self.fired = True
+            self._note_fired(step)
             err = ChaosCrash(
                 f"chaos: injected host crash at step {int(step)}"
                 + (" (mid-collective)" if self.mode == "mid_collective"

@@ -179,11 +179,14 @@ class Checkpointer:
 
     Set ``.events`` to an :class:`~tpu_compressed_dp.obs.export.EventStream`
     to get ``ckpt_save`` / ``ckpt_rollback`` records on the ``--events``
-    stream (emission failures never propagate into the save path).
+    stream (emission failures never propagate into the save path).  Set
+    ``.flight`` to a :class:`~tpu_compressed_dp.obs.flight.FlightRecorder`
+    to additionally tee the lifecycle into its ``ckpt`` ring and dump a
+    blackbox bundle when a restore raises :class:`CheckpointCorrupt`.
     """
 
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
-                 events=None):
+                 events=None, flight=None):
         self.directory = os.path.abspath(directory)
         # GC is ours (best-step pinning); Orbax keeps everything
         self.manager = ocp.CheckpointManager(
@@ -195,6 +198,7 @@ class Checkpointer:
         #: the pinned step of the best checkpoint; GC never evicts it
         self.best_step: Optional[int] = None
         self.events = events
+        self.flight = flight
         #: last background write failure popped by a non-raising barrier
         self.last_save_error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
@@ -422,9 +426,11 @@ class Checkpointer:
         if step is not None:
             problems = verify_step_dir(self.directory, int(step))
             if problems:
-                raise CheckpointCorrupt(
+                err = CheckpointCorrupt(
                     f"checkpoint step {int(step)} failed verification: "
                     + "; ".join(problems))
+                self._observe_corrupt(err, step=int(step))
+                raise err
             payload = self._restore_payload(int(step), template)
             return self._finish_restore(target_state, payload)
 
@@ -458,6 +464,9 @@ class Checkpointer:
                            rollback_steps=rollback, skipped=skipped)
             return self._finish_restore(target_state, payload)
         assert first_err is not None
+        if isinstance(first_err, CheckpointCorrupt):
+            # the walk-back exhausted the chain: NOTHING on disk verifies
+            self._observe_corrupt(first_err, step=newest)
         raise first_err
 
     def _restore_payload(self, step: int, template: Dict[str, Any]
@@ -558,6 +567,12 @@ class Checkpointer:
             }
 
     def _emit(self, kind: str, **fields) -> None:
+        fl = self.flight
+        if fl is not None:
+            try:
+                fl.record("ckpt", kind, **fields)
+            except Exception:
+                pass  # telemetry must never fail a save/restore
         ev = self.events
         if ev is None:
             return
@@ -565,6 +580,15 @@ class Checkpointer:
             ev.emit(kind, **fields)
         except Exception:
             pass  # telemetry must never fail a save/restore
+
+    def _observe_corrupt(self, err: BaseException, *, step: int) -> None:
+        fl = self.flight
+        if fl is None:
+            return
+        try:
+            fl.observe(err, step=step)
+        except Exception:
+            pass  # forensics must never mask the corruption itself
 
     # ----------------------------------------------------------------- close
 

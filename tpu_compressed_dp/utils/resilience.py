@@ -284,6 +284,7 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
                     max_wedge_steps: Optional[int] = None,
                     min_steps_per_sec: Optional[float] = None,
                     max_ckpt_age_s: Optional[float] = None,
+                    max_straggler_skew_s: Optional[float] = None,
                     now: Optional[float] = None,
                     hb: Optional[Dict[str, Any]] = None) -> list:
     """Health-check a heartbeat file; returns a list of problem strings
@@ -308,6 +309,11 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
       exceeds ``max_ckpt_age_s``: training advances but nothing durable is
       landing — a wedged async writer or a full/readonly checkpoint disk,
       the failure a crash would silently amplify into lost work.
+    * **straggler** — ``straggler_skew_s`` (the flight recorder's live
+      cross-rank skew of the mean host step time, from
+      ``FlightRecorder.publish``) exceeds ``max_straggler_skew_s``: one
+      rank is pacing every collective for the whole world — the failure
+      mode worth catching BEFORE it becomes a peer-timeout remesh.
 
     Wedge/stall/checkpoint checks are skipped when their payload fields are
     absent (guard/telemetry/checkpointing off) — absence of optional
@@ -352,6 +358,16 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
                 f"(> {max_ckpt_age_s:g}s, last_ckpt_step="
                 f"{hb.get('last_ckpt_step')}) — a crash now loses that much "
                 "work")
+    skew = hb.get("straggler_skew_s", tele.get("straggler_skew_s"))
+    if max_straggler_skew_s is not None and skew is not None:
+        if float(skew) > max_straggler_skew_s:
+            rank = hb.get("straggler_rank")
+            problems.append(
+                f"straggler: cross-rank step-time skew {float(skew):.4g}s "
+                f"exceeds the {max_straggler_skew_s:g}s bound"
+                + (f" (slowest rank {int(rank)})"
+                   if isinstance(rank, (int, float)) and rank >= 0 else "")
+                + " — one rank is pacing the whole world's collectives")
     return problems
 
 
@@ -364,6 +380,7 @@ def run_with_recovery(
     start_epoch: int = 0,
     max_retries: int = 3,
     on_restore: Optional[Callable[[Any], Any]] = None,
+    flight=None,
 ) -> Tuple[Any, Dict[str, int]]:
     """Run ``state = epoch_fn(state, epoch)`` for each epoch, restoring from
     ``checkpointer`` (latest step) and retrying after exceptions.
@@ -379,6 +396,11 @@ def run_with_recovery(
     consumes a retry either: ``Checkpointer.restore`` walks back to the
     newest verifiable checkpoint internally, so a torn latest write costs a
     rollback (accounted in ``ckpt/rollback_steps``), not a failure.
+
+    ``flight`` (a :class:`~tpu_compressed_dp.obs.flight.FlightRecorder`)
+    dumps a blackbox bundle when the retry budget is exhausted — the
+    TERMINAL error, the one the process dies with; per-retry failures are
+    recoverable by construction and stay out of the shared dir.
     """
     failures = restores = 0
     epoch = start_epoch
@@ -392,6 +414,9 @@ def run_with_recovery(
         except Exception as train_err:
             failures += 1
             if checkpointer is None or failures > max_retries:
+                if flight is not None:
+                    flight.observe(train_err, retries=failures - 1,
+                                   terminal=True)
                 raise
             try:
                 state, meta = checkpointer.restore(state)
@@ -400,6 +425,9 @@ def run_with_recovery(
                 # nothing to replay from, and letting the restore's
                 # FileNotFoundError propagate would mask the actual
                 # training failure the operator needs to see
+                if flight is not None:
+                    flight.observe(train_err, retries=failures - 1,
+                                   terminal=True)
                 raise train_err
             if on_restore is not None:
                 state = on_restore(state)
