@@ -49,6 +49,7 @@ def test_run_point_projected_comm_columns(mesh8):
             > rec["projected_allreduce_gbps_per_chip"])
 
 
+@pytest.mark.slow  # ~11 s; run_point rows keep the projection columns quick
 def test_projection_method_aware_topk_vs_randomk(mesh8):
     """VERDICT r2 #2 done-criterion: at W>2 and equal ratio, topk (all_gather,
     64 bits/elem) must project strictly more per-chip traffic than shared-seed
@@ -119,6 +120,7 @@ def test_run_adaptive_point_schema_and_convergence(mesh8):
             < rec["static_rungs"][0]["bits_per_update"] * rec["updates"])
 
 
+@pytest.mark.slow  # ~11 s; run_adaptive_point schema row keeps adaptive-sweep quick coverage
 def test_run_sweep_adaptive_cli(mesh8, capsys):
     args = sweep.build_parser().parse_args([
         "--model", "resnet9", "--methods", "topk,terngrad",

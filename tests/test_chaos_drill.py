@@ -34,6 +34,13 @@ def test_quick_drill(mesh8):
     assert results["ckpt_preempt"]["resumed_from"] == 3
     assert results["ckpt_preempt"]["bitwise"] is True
     assert results["ckpt_corrupt"]["rollback_steps"] == 1
+    # stream acceptance rows: torn delta -> walk back to the keyframe and
+    # re-converge bitwise; torn keyframe with no later anchor -> warm
+    # rejoin refuses the stream (full-restore fallback)
+    assert results["stream_corrupt"]["corrupt_segments"] == 1
+    assert results["stream_corrupt"]["walkback_seq"] == 0
+    assert results["stream_corrupt"]["reconverged"] is True
+    assert results["stream_corrupt"]["keyframe_fallback"] is True
     # ISSUE 11 acceptance row: crash-relaunch mid-decision-window replays
     # the same rung schedule and the same control_decision events
     assert results["control_resume"]["rungs"] == [1, 2, 2]

@@ -378,6 +378,8 @@ def test_remesh_recomputes_rung_targets_without_wedging(mesh8):
 
 # ---------------------------------------------------------------- dawn e2e
 
+@pytest.mark.slow  # ~34 s dawn compile; the in-process closed-loop
+# convergence + resume rows keep the control plane in tier-1
 def test_dawn_adaptive_e2e_and_control_report(tmp_path, mesh8):
     """The acceptance run: dawn under ``--adaptive`` with comm priced far
     above a pinned budget descends the rung ladder (the per-epoch sent

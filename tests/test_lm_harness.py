@@ -91,6 +91,7 @@ def test_lm_harness_e2e(tmp_path):
     assert s2["step"] == 26
 
 
+@pytest.mark.slow  # ~12 s; the lm e2e row keeps the harness quick path
 def test_lm_harness_clip_stabilisers(tmp_path):
     """randomk + EF + momentum with both clip stabilisers on the 3-D mesh:
     finite loss, training progresses (the EF-momentum protocol the CNN step

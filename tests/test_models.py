@@ -42,9 +42,12 @@ def test_resnet9_param_count():
     assert 6.4e6 < n < 6.8e6, n
 
 
+@pytest.mark.slow
 def test_vgg16_matches_torchvision_param_count():
     """VGG-16 (no BN), 10 classes, 7x7 adaptive pool: same layer dims as
-    torchvision => 134.3M params (1000-class version also checked)."""
+    torchvision => 134.3M params (1000-class version also checked).
+    Slow-marked: building the 134M-param tree costs ~15 s of the tier-1
+    budget for a pure count check."""
     params, _ = init_model(vgg.vgg16(), jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
     n = n_params(params)
     # torchvision vgg16 w/ 1000 classes = 138_357_544; with 10 classes:
@@ -52,6 +55,7 @@ def test_vgg16_matches_torchvision_param_count():
     assert n == expected, (n, expected)
 
 
+@pytest.mark.slow  # ~8 s build; forward-shape row keeps resnet50 quick coverage
 def test_resnet50_param_count():
     params, _ = init_model(
         resnet.resnet50(num_classes=1000), jax.random.key(0), jnp.zeros((1, 64, 64, 3))

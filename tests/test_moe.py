@@ -59,6 +59,7 @@ class TestMoEFFN:
         nonzero_tokens = int(jnp.sum(jnp.any(out.reshape(-1, 32) != 0, axis=-1)))
         assert nonzero_tokens <= 4
 
+    @pytest.mark.slow  # ~18 s; MoE keeps quick rows (step+compression, lm flag)
     def test_sharded_matches_unsharded(self):
         # capacity queues are per (data, seq) shard — parity with the
         # unsharded run holds exactly only in the drop-free regime, so use a

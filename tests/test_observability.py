@@ -374,6 +374,19 @@ class TestWatchdog:
         # without the flag the checkpoint clock is never consulted
         assert wd.main(["--check", "--heartbeat", p]) == 0
 
+    def test_max_stream_lag_cli(self, tmp_path):
+        """--max_stream_lag reads the StreamWriter.heartbeat_fields payload
+        the harnesses fold into the heartbeat (delta-stream satellite)."""
+        import tools.watchdog as wd
+
+        p = self._hb(tmp_path, stream_last_step=50, stream_lag_s=500.0)
+        assert wd.main(["--check", "--heartbeat", p,
+                        "--max_stream_lag", "1000"]) == 0
+        assert wd.main(["--check", "--heartbeat", p,
+                        "--max_stream_lag", "60"]) == 1
+        # without the flag the stream clock is never consulted
+        assert wd.main(["--check", "--heartbeat", p]) == 0
+
     def test_max_straggler_skew_cli(self, tmp_path):
         """--max_straggler_skew reads the flight recorder's live
         straggler_skew_s the harnesses fold into the heartbeat."""
@@ -759,6 +772,8 @@ class TestProfileTraceContext:
 
 @pytest.mark.quick
 class TestTensorboardLogger:
+    @pytest.mark.slow  # ~9 s TF import; events/prom/heartbeat are the
+    # primary telemetry surfaces and stay tier-1
     def test_writes_scalars_and_json(self, tmp_path):
         tb = TensorboardLogger(str(tmp_path / "tb"))
         tb.update_examples_count(512)

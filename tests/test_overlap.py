@@ -88,12 +88,14 @@ def _assert_bitwise(a, b):
 # slow-marked full cross-product below, keeping tier-1 inside its 870 s
 # budget.
 QUICK_CASES = [
-    dict(method="topk", ratio=0.25, granularity="layerwise",
-         error_feedback=True),
     dict(method="topk", ratio=0.25, granularity="bucketed", bucket_mb=0.05,
          mode="wire", transport="allgather", error_feedback=True),
 ]
 SLOW_CASES = [
+    # the simulate-mode row mirrors the wire row above (~26 s of the
+    # tier-1 budget); the wire transport is the shipped hot path
+    dict(method="topk", ratio=0.25, granularity="layerwise",
+         error_feedback=True),
     dict(method=None, granularity="bucketed", bucket_mb=0.01),
     dict(method="topk", ratio=0.25, granularity="bucketed", bucket_mb=0.1,
          mode="wire", transport="sharded", error_feedback=True),

@@ -41,7 +41,12 @@ def _setup(cfg, mesh, comp, lr=0.0, microbatches=2):
     return params, state, step
 
 
-@pytest.mark.parametrize("dp,pp,mb", [(1, 2, 2), (2, 2, 2), (1, 4, 3), (2, 4, 1)])
+@pytest.mark.parametrize("dp,pp,mb", [
+    pytest.param(1, 2, 2, marks=pytest.mark.slow),
+    (2, 2, 2),   # the general dp>1 row stays tier-1
+    pytest.param(1, 4, 3, marks=pytest.mark.slow),
+    (2, 4, 1),
+])
 def test_pipeline_loss_matches_single_device(dp, pp, mb):
     cfg = _cfg()
     x = jax.random.randint(jax.random.key(1), (4 * dp * mb, 16), 0, 64)
@@ -239,6 +244,8 @@ def test_pipeline_full_composition_matches_single_device(dp, sp, pp, tp, mb):
     assert float(m["loss"]) == pytest.approx(ref, rel=1e-5)
 
 
+@pytest.mark.slow  # ~8 s; the tensor-composition parity row and
+# test_pipeline_tensor_learns keep dp+pp+tp quick coverage
 def test_pipeline_full_composition_learns_with_compression():
     cfg = _cfg()
     mesh = make_pp_mesh(1, 2, 2, 2)

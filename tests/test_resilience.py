@@ -407,3 +407,22 @@ class TestCheckpointStaleCheck:
         hb2 = {"ts": now, "step": 5}
         assert resilience.check_heartbeat(
             "x", max_age_s=60, max_ckpt_age_s=1.0, now=now, hb=hb2) == []
+
+    def test_stream_lag_adds_heartbeat_age(self):
+        """The watchdog's --max_stream_lag check mirrors the checkpoint
+        clock: the payload's stream_lag_s plus the heartbeat's own age,
+        so a dying writer cannot freeze the stream clock either."""
+        now = 1000.0
+        hb = {"ts": now - 10.0, "step": 5, "stream_last_step": 4,
+              "stream_lag_s": 100.0}
+        assert resilience.check_heartbeat(
+            "x", max_age_s=60, max_stream_lag_s=200.0, now=now, hb=hb) == []
+        # 100 (payload) + 10 (heartbeat age) = 110 > 105
+        probs = resilience.check_heartbeat(
+            "x", max_age_s=60, max_stream_lag_s=105.0, now=now, hb=hb)
+        assert len(probs) == 1 and "stream stale" in probs[0]
+        assert "stream_last_step=4" in probs[0]
+        # absent field (streaming off) skips the check, not fails it
+        hb2 = {"ts": now, "step": 5}
+        assert resilience.check_heartbeat(
+            "x", max_age_s=60, max_stream_lag_s=1.0, now=now, hb=hb2) == []

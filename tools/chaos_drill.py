@@ -38,6 +38,13 @@ Checkpoint drills (the ISSUE 9 acceptance rows — utils/checkpoint.py):
     caught by the manifest digest; restore walks back to the previous
     verifiable step (``ckpt/rollback_steps`` + ``ckpt_rollback`` event)
     instead of raising.
+  * ``stream_corrupt`` — same discipline for the delta state stream
+    (stream/): a flipped byte in a mid-window delta segment is caught by
+    the segment manifest digest; the consumer walks BACK to its stored
+    keyframe (bitwise) and re-converges bitwise at the next keyframe +
+    window close.  A torn keyframe with no later anchor makes the stream
+    unusable: ``warm_rejoin`` refuses it and the joiner falls back to the
+    full Orbax restore path instead of adopting a half-applied state.
 
 Elastic drills (the ISSUE 7 acceptance row — train/elastic.py):
 
@@ -557,6 +564,106 @@ def drill_ckpt_corrupt(mesh, *, n_steps=4) -> Dict:
     return {"rollback_steps": 1, "restored_step": n_steps - 1}
 
 
+def drill_stream_corrupt(mesh, *, keyframe_every=4) -> Dict:
+    """A flipped payload byte in a mid-window delta segment is caught by
+    the segment manifest digest => the consumer walks back to its stored
+    keyframe bitwise and re-converges bitwise once the next keyframe and
+    window close land; a torn keyframe with no later anchor makes the
+    stream unusable => ``warm_rejoin`` returns no adoption info and the
+    joiner takes the full-restore path."""
+    import copy
+
+    from tpu_compressed_dp.stream.reader import StreamReader
+    from tpu_compressed_dp.stream.rejoin import warm_rejoin
+    from tpu_compressed_dp.stream.store import (StreamCorrupt,
+                                                segment_payload_path)
+    from tpu_compressed_dp.stream.writer import StreamWriter
+
+    rng = np.random.RandomState(7)
+    params = {"dense": {"kernel": rng.randn(48, 8).astype(np.float32)},
+              "bias": rng.randn(64).astype(np.float32)}
+
+    def advance():
+        params["dense"]["kernel"] = (
+            params["dense"]["kernel"]
+            + rng.randn(48, 8).astype(np.float32) * 0.01)
+        params["bias"] = (params["bias"]
+                          + rng.randn(64).astype(np.float32) * 0.01)
+
+    def flip(path):
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+    def quiet(*a, **k):
+        pass
+
+    @dataclasses.dataclass
+    class Joiner:
+        params: dict
+        step: int
+
+    with tempfile.TemporaryDirectory() as td:
+        sd = os.path.join(td, "stream")
+        w = StreamWriter(sd, ratio=0.25, keyframe_every=keyframe_every,
+                         log=quiet)
+        w.append(params, step=1)                    # seq 0: keyframe
+        kf_params = copy.deepcopy(params)
+        advance(); w.append(params, step=2)         # seq 1: delta
+        advance(); w.append(params, step=3)         # seq 2: delta (mid-window)
+
+        flip(segment_payload_path(sd, 2))           # torn delta
+
+        r = StreamReader(sd, log=quiet)
+        r.catch_up()
+        # the digest notices; the consumer never serves the torn delta —
+        # it reverts to the last keyframe's reconstruction, bitwise
+        assert r.metrics()["stream/corrupt_segments"] == 1.0
+        assert int(r.applied_seq) == 0 and int(r.applied_step) == 1
+        _assert_bitwise(kf_params, r.params_like(kf_params),
+                        "stream_corrupt walk-back")
+
+        advance(); w.append(params, step=4)         # seq 3: flush (skipped —
+        #                                             awaiting a keyframe)
+        advance(); w.append(params, step=5)         # seq 4: fresh keyframe
+        kf2 = copy.deepcopy(params)
+        r.catch_up()
+        assert int(r.applied_seq) == 4, int(r.applied_seq)
+        _assert_bitwise(kf2, r.params_like(kf2), "stream_corrupt re-anchor")
+        advance(); w.sync(params, step=6)           # window-closing flush
+        r.catch_up()
+        assert r.exact, "head not exact after sync"
+        _assert_bitwise(params, r.params_like(params),
+                        "stream_corrupt reconverged head")
+        w.close()
+
+        # half two: a torn KEYFRAME with no later anchor is unusable — the
+        # reader raises and warm rejoin refuses to adopt anything
+        sd2 = os.path.join(td, "stream2")
+        params2 = {"w": rng.randn(128).astype(np.float32)}
+        w2 = StreamWriter(sd2, ratio=0.25, keyframe_every=keyframe_every,
+                          log=quiet)
+        w2.append(params2, step=1)                  # seq 0: keyframe
+        params2["w"] = params2["w"] + 0.5
+        w2.append(params2, step=2)                  # seq 1: delta
+        w2.close()
+        flip(segment_payload_path(sd2, 0))
+        try:
+            StreamReader(sd2, log=quiet).catch_up()
+            raise AssertionError("torn keyframe went unnoticed")
+        except StreamCorrupt:
+            pass
+        joiner = Joiner(params=copy.deepcopy(params2), step=0)
+        adopted, info = warm_rejoin(joiner, sd2, log=quiet)
+        assert info is None and adopted is joiner, (
+            "warm rejoin adopted from an unusable stream")
+    return {"corrupt_segments": 1, "walkback_seq": 0, "reconverged": True,
+            "keyframe_fallback": True}
+
+
 def drill_control_resume(mesh, *, preempt_at_step=4, n_steps=9) -> Dict:
     """Crash-relaunch MID-decision-window resumes the adaptive controller
     bitwise: the saved ControlState (riding the checkpoint next to guard)
@@ -754,7 +861,7 @@ def drill_elastic_remesh(mesh, *, kill_step=2, worker=3, policy="fold",
     assert set(el.metrics()) == {
         "elastic/peer_failures", "elastic/remesh_count",
         "elastic/dropped_ef_norm", "elastic/remesh_latency_ms",
-        "elastic/remesh_ms"}
+        "elastic/remesh_ms", "stream/rejoin_bytes"}
     assert el.metrics()["elastic/remesh_ms"] >= el.remesh_latency_ms
     for leaf in jax.tree.leaves(state.ef):
         assert np.asarray(leaf).shape[0] == W - 1
@@ -1241,7 +1348,7 @@ def drill_forensics(mesh) -> Dict:
 
 QUICK = ["skip_consistency", "loss_scale", "max_skips", "crash_recovery",
          "elastic_gossip", "elastic_remesh", "ckpt_preempt", "ckpt_corrupt",
-         "control_resume", "fleet", "forensics"]
+         "stream_corrupt", "control_resume", "fleet", "forensics"]
 FULL = QUICK + ["comp_hold", "ef_identity", "poison_control",
                 "skip_matrix", "ef_identity_sharded",
                 "elastic_readmit", "elastic_cascade", "elastic_matrix",
@@ -1327,7 +1434,7 @@ def main(argv=None) -> int:
                    help="tier-1 smoke subset (skip_consistency, loss_scale, "
                         "max_skips, crash_recovery, elastic_gossip, "
                         "elastic_remesh, ckpt_preempt, ckpt_corrupt, "
-                        "control_resume, fleet, forensics)")
+                        "stream_corrupt, control_resume, fleet, forensics)")
     p.add_argument("--drill", action="append", default=None,
                    help="run only the named drill(s)")
     p.add_argument("--list", action="store_true",

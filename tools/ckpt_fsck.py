@@ -18,11 +18,22 @@ preemption, or from cron over a fleet's checkpoint trees:
     orphaned manifests), leaving only steps a restore can actually use.
     Exit 0 after pruning.
 
+Delta state streams (:mod:`tpu_compressed_dp.stream`, harness
+``--stream_dir``) use the same manifest-checksum discipline per segment,
+and fsck covers them with the same verbs: if the target directory is a
+stream dir — or has a ``stream/`` subdirectory next to the checkpoints —
+segments are verified (``seg N: OK/CORRUPT``) and listed, and ``--prune``
+drops superseded delta windows via
+:func:`tpu_compressed_dp.stream.store.prune_segments` (``--keep_windows``,
+default 2).  Exit semantics are unchanged: 1 = any step OR segment is
+corrupt, 2 = nothing verifiable at all (no steps and no segments).
+
 Pure host-side file I/O — no JAX or Orbax import, safe to run anywhere::
 
     python tools/ckpt_fsck.py /ckpts/run17
     python tools/ckpt_fsck.py /ckpts/run17 --list
     python tools/ckpt_fsck.py /ckpts/run17 --prune
+    python tools/ckpt_fsck.py /runs/lm17/stream            # stream dir
 """
 
 from __future__ import annotations
@@ -33,8 +44,23 @@ import shutil
 import sys
 from typing import List, Optional
 
+from tpu_compressed_dp.stream.store import (is_stream_dir, list_segments,
+                                            prune_segments,
+                                            read_segment_manifest,
+                                            verify_stream)
 from tpu_compressed_dp.utils.checkpoint import (list_step_dirs, manifest_path,
                                                 read_manifest, verify_step_dir)
+
+
+def _find_stream_dir(directory: str) -> Optional[str]:
+    """The directory itself if it is a delta stream, else its ``stream/``
+    subdirectory when a harness kept checkpoints and stream side by side."""
+    if is_stream_dir(directory):
+        return directory
+    sub = os.path.join(directory, "stream")
+    if is_stream_dir(sub):
+        return sub
+    return None
 
 
 def _orphan_manifests(directory: str, steps: List[int]) -> List[str]:
@@ -59,14 +85,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--list", action="store_true",
                    help="list steps + manifest summaries, no verification")
     p.add_argument("--prune", action="store_true",
-                   help="delete corrupt step dirs + orphaned manifests")
+                   help="delete corrupt step dirs + orphaned manifests; for "
+                        "streams, drop superseded delta windows")
+    p.add_argument("--keep_windows", type=int, default=2,
+                   help="stream --prune: keyframe windows to retain "
+                        "(default 2)")
     args = p.parse_args(argv)
 
     if not os.path.isdir(args.dir):
         print(f"ckpt_fsck: no such directory: {args.dir}")
         return 2
     steps = list_step_dirs(args.dir)
-    if not steps:
+    stream_dir = _find_stream_dir(args.dir)
+    seqs = list_segments(stream_dir) if stream_dir is not None else []
+    if not steps and not seqs:
         print(f"ckpt_fsck: no checkpoints under {args.dir}")
         return 2
 
@@ -81,6 +113,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             meta_keys = ",".join(sorted((man.get("meta") or {}).keys())) or "-"
             print(f"step {s}: {len(files)} files, {total} bytes, "
                   f"meta[{meta_keys}]")
+        for q in seqs:
+            man = read_segment_manifest(stream_dir, q)
+            if man is None:
+                print(f"seg {q}: (manifest unreadable)")
+                continue
+            close = " window-close" if man.get("window_close") else ""
+            print(f"seg {q}: {man.get('kind')} step {man.get('step')}, "
+                  f"{man.get('bytes')} bytes, nnz {man.get('nnz')}{close}")
         return 0
 
     bad: List[int] = []
@@ -99,6 +139,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     for o in orphans:
         print(f"orphaned manifest: {o}")
 
+    stream_problems: List[str] = []
+    if stream_dir is not None:
+        stream_problems, all_seqs = verify_stream(stream_dir)
+        bad_seqs = set()
+        for pr in stream_problems:
+            print(f"stream: CORRUPT: {pr}")
+            if pr.startswith("segment "):
+                head = pr[len("segment "):].split(":", 1)[0]
+                if head.isdigit():
+                    bad_seqs.add(int(head))
+        for q in all_seqs:
+            if q not in bad_seqs:
+                print(f"seg {q}: OK")
+
     if args.prune:
         for s in bad:
             shutil.rmtree(os.path.join(args.dir, str(s)), ignore_errors=True)
@@ -113,8 +167,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"pruned {o}")
             except OSError:
                 pass
+        if stream_dir is not None:
+            dropped = prune_segments(stream_dir,
+                                     keep_windows=args.keep_windows)
+            for q in dropped:
+                print(f"pruned seg {q}")
         return 0
-    return 1 if bad else 0
+    return 1 if (bad or stream_problems) else 0
 
 
 if __name__ == "__main__":

@@ -23,6 +23,9 @@ Checks (see :func:`tpu_compressed_dp.utils.resilience.check_heartbeat`):
   * **checkpoint-stale** — heartbeat ``ckpt_age_s`` (plus the heartbeat's
     own age) exceeds ``--max_ckpt_age``: the run is making progress it
     could not recover — a crash now loses that much work.
+  * **stream-stale** — heartbeat ``stream_lag_s`` (plus the heartbeat's
+    own age) exceeds ``--max_stream_lag``: the delta state stream stopped
+    advancing — warm rejoin and serving consumers are going stale.
   * **straggler** — heartbeat ``straggler_skew_s`` (the flight recorder's
     live cross-rank step-time skew) exceeds ``--max_straggler_skew``: one
     rank is pacing the whole world's collectives.
@@ -92,6 +95,7 @@ def run_check(args) -> int:
         max_wedge_steps=args.max_wedge,
         min_steps_per_sec=args.min_step_rate,
         max_ckpt_age_s=args.max_ckpt_age,
+        max_stream_lag_s=args.max_stream_lag,
         max_straggler_skew_s=args.max_straggler_skew,
         hb=hb,
     )
@@ -299,6 +303,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="max seconds since the run's last durable "
                         "checkpoint (heartbeat ckpt_age_s + heartbeat age; "
                         "default: no checkpoint-staleness check)")
+    p.add_argument("--max_stream_lag", type=float, default=None,
+                   help="max seconds since the last delta-stream segment "
+                        "(heartbeat stream_lag_s + heartbeat age; default: "
+                        "no stream-staleness check)")
     p.add_argument("--max_straggler_skew", type=float, default=None,
                    help="max cross-rank step-time skew in seconds "
                         "(heartbeat straggler_skew_s, from the flight "

@@ -75,6 +75,13 @@ REPLAY_DETERMINISTIC_MODULES = (
     # records by seq, never by wall reads of its own
     "tpu_compressed_dp/obs/flight.py",
     "tools/postmortem.py",
+    # the delta state stream: segment content and window accounting must
+    # replay bitwise (the lossless-window invariant) — segment timestamps
+    # ride in via the writer's injected wall clock
+    "tpu_compressed_dp/stream/delta.py",
+    "tpu_compressed_dp/stream/writer.py",
+    "tpu_compressed_dp/stream/reader.py",
+    "tpu_compressed_dp/stream/rejoin.py",
 )
 
 #: modules that write records other processes read over shared storage —
@@ -92,12 +99,16 @@ SHARED_DIR_MODULES = (
     # peers / the watchdog read concurrently over the shared dir
     "tpu_compressed_dp/obs/flight.py",
     "tools/postmortem.py",
+    # stream segments: the training rank writes, joiners and serving
+    # consumers tail the same directory concurrently
+    "tpu_compressed_dp/stream/store.py",
+    "tools/stream_serve.py",
 )
 
 #: registry-governed stat-key families (TCDP103); literals shaped
 #: "<family>/<name>" with these families must be declared
 STAT_FAMILIES = ("comm", "guard", "elastic", "ckpt", "throughput", "time",
-                 "net", "control", "fleet", "flight", "straggler")
+                 "net", "control", "fleet", "flight", "straggler", "stream")
 STAT_KEY_RE = re.compile(r"^(?:%s)/[a-z0-9_]+$" % "|".join(STAT_FAMILIES))
 
 _WALLCLOCK_CALLS = frozenset({

@@ -33,6 +33,7 @@ from tpu_compressed_dp.data import cifar10 as data
 from tpu_compressed_dp.harness.loop import (add_adaptive_args,
                                             add_checkpoint_args,
                                             add_robustness_args,
+                                            add_stream_args,
                                             add_telemetry_args,
                                             add_topology_args,
                                             build_control,
@@ -43,8 +44,10 @@ from tpu_compressed_dp.harness.loop import (add_adaptive_args,
                                             make_event_stream,
                                             make_flight_recorder,
                                             make_heartbeat,
-                                            make_preemption, preempt_exit,
-                                            profile_trace, prom_labels,
+                                            make_preemption, make_stream,
+                                            preempt_exit, profile_trace,
+                                            prom_labels,
+                                            stream_rejoin_params,
                                             train_epoch)
 from tpu_compressed_dp.models import alexnet as alexnet_mod
 from tpu_compressed_dp.models import resnet9 as resnet9_mod
@@ -224,6 +227,10 @@ def build_parser() -> argparse.ArgumentParser:
     add_checkpoint_args(p, cadence_help="epochs between async checkpoint "
                                         "saves (requires --checkpoint_dir; "
                                         "0 = emergency/final saves only)")
+    # delta state streaming: shared --stream* surface (stream/)
+    add_stream_args(p, cadence_help="epochs between delta-stream appends "
+                                    "(requires --stream_dir; 0 disables "
+                                    "the periodic append)")
     # telemetry: shared --events/--prom surface (obs/export.py)
     add_telemetry_args(p)
     p.add_argument("--tensorboard", action="store_true",
@@ -484,17 +491,26 @@ def run(args) -> dict:
         flight.note_chaos(chaos)
     if flight is not None and crash is not None:
         crash.flight = flight
+    stream = make_stream(args, flight=flight, events=events)
     if ckpt is not None:
         ckpt.events = events
         ckpt.flight = flight
+        # committed full checkpoints re-anchor the delta stream's window
+        ckpt.stream = stream
     preempt = make_preemption()
     el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events,
-                       flight=flight)
+                       flight=flight, stream=stream)
     if el is not None and rejoin is not None:
         # watchdog-relaunched host: adopt the running world's replicated
         # state from the re-elected coordinator's broadcast (EF rows start
-        # at zero) and retrace the steps on the post-join mesh
-        state = el.join_world(state, rejoin)
+        # at zero) and retrace the steps on the post-join mesh.  With
+        # --stream_rejoin the params come off the delta stream instead of
+        # the broadcast (the survivors' barrier flushed it bitwise-equal
+        # to the live params before admitting us).
+        adopted_params, adopted_info = stream_rejoin_params(
+            args, state, flight=flight)
+        state = el.join_world(state, rejoin, adopted_params=adopted_params,
+                              adopted_info=adopted_info)
         mesh, ndev = el.mesh, el.world
         step_cache.clear()
         eval_step = make_eval_step(apply_fn, mesh)
@@ -640,6 +656,11 @@ def run(args) -> dict:
                 # async: snapshot to host and return — the write overlaps
                 # the next epoch; the next save (or preemption) barriers
                 ckpt.save_async(state, {"epoch": epoch})
+            if (stream is not None and args.stream_every > 0
+                    and (epoch + 1) % args.stream_every == 0):
+                # delta segment: codec on this thread, commit in the
+                # background (stream/writer.py)
+                stream.append_async(state.params, step=int(state.step))
             train_time = epoch_stats["train time"]
             examples = len(cur_train) * cur_bs
             thr = flops_mod.throughput_record(
@@ -661,6 +682,8 @@ def run(args) -> dict:
                     epoch=epoch,
                     telemetry=telemetry_snapshot(timeline),
                     **(ckpt.heartbeat_fields() if ckpt is not None else {}),
+                    **(stream.heartbeat_fields() if stream is not None
+                       else {}),
                     **({"elastic": el.metrics()} if el is not None else {}),
                     **(controller.heartbeat_fields(state.control)
                        if controller is not None else {}),
@@ -704,6 +727,7 @@ def run(args) -> dict:
                      **thr, **comm_means, **guard_last, **control_stats,
                      **timeline.snapshot(),
                      **(ckpt.metrics() if ckpt is not None else {}),
+                     **(stream.metrics() if stream is not None else {}),
                      **(el.metrics() if el is not None else {}),
                      **fgauges},
                     job_scoped(args, args.prom),
@@ -732,6 +756,8 @@ def run(args) -> dict:
         tb.close()
         if ckpt is not None:
             ckpt.close()  # drains the background writer before events close
+        if stream is not None:
+            stream.close()  # drains the in-flight segment commit
         if events is not None:
             events.close()
         if hb is not None:

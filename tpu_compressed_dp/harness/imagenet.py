@@ -46,6 +46,7 @@ from tpu_compressed_dp.data import imagenet as data
 from tpu_compressed_dp.harness.loop import (
     add_adaptive_args,
     add_robustness_args,
+    add_stream_args,
     add_telemetry_args,
     add_topology_args,
     fabric_gauges,
@@ -60,7 +61,9 @@ from tpu_compressed_dp.harness.loop import (
     make_flight_recorder,
     make_heartbeat,
     make_preemption,
+    make_stream,
     prom_labels,
+    stream_rejoin_params,
     comm_summary,
     guard_summary,
     pad_batch,
@@ -302,6 +305,10 @@ def build_parser() -> argparse.ArgumentParser:
     add_robustness_args(p, check_note="checked at epoch end")
     # adaptive compression: shared --adaptive* surface (control/)
     add_adaptive_args(p)
+    # delta state streaming: shared --stream* surface (stream/)
+    add_stream_args(p, cadence_help="epochs between delta-stream appends "
+                                    "(requires --stream_dir; 0 disables "
+                                    "the periodic append)")
     # telemetry: shared --events/--prom surface (obs/export.py)
     add_telemetry_args(p)
     p.add_argument("--logdir", type=str, default=None)
@@ -485,19 +492,26 @@ def run(args) -> Dict[str, float]:
         flight.note_chaos(chaos)
     if flight is not None and crash is not None:
         crash.flight = flight
+    stream = make_stream(args, flight=flight, events=events)
     if ckpt is not None:
         ckpt.events = events   # save/rollback records on the run's stream
         ckpt.flight = flight
+        # committed full checkpoints re-anchor the delta stream's window
+        ckpt.stream = stream
     preempt = make_preemption()
     el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events,
-                       flight=flight)
+                       flight=flight, stream=stream)
     if el is not None and rejoin is not None:
         # watchdog-relaunched host: the surviving world is mid-training.
         # Adopt its replicated state (broadcast from the re-elected
         # coordinator), zero EF rows, and train on the joined mesh — the
         # jitted steps built above targeted the fresh-init mesh and are
-        # rebuilt against the post-join one.
-        state = el.join_world(state, rejoin)
+        # rebuilt against the post-join one.  With --stream_rejoin the
+        # params adopt from the delta stream, not the broadcast.
+        adopted_params, adopted_info = stream_rejoin_params(
+            args, state, flight=flight)
+        state = el.join_world(state, rejoin, adopted_params=adopted_params,
+                              adopted_info=adopted_info)
         mesh, ndev = el.mesh, el.world
         step_cache.clear()
         train_step = train_step_for(active_comp())
@@ -620,6 +634,11 @@ def run(args) -> Dict[str, float]:
                     train_step = train_step_for(active_comp())
                     eval_step = make_eval_step(apply_fn, mesh)
                     fwd_cache.clear()
+            if (stream is not None and args.stream_every > 0
+                    and (epoch + 1) % args.stream_every == 0):
+                # delta segment: codec on this thread, commit in the
+                # background (stream/writer.py)
+                stream.append_async(state.params, step=int(state.step))
             # spans drain ONCE per epoch and fan out to every consumer
             # (event stream, flight recorder's timing ring + phase profile)
             spans = timeline.drain()
@@ -632,6 +651,8 @@ def run(args) -> Dict[str, float]:
                     epoch=epoch,
                     telemetry=telemetry_snapshot(timeline),
                     **(ckpt.heartbeat_fields() if ckpt is not None else {}),
+                    **(stream.heartbeat_fields() if stream is not None
+                       else {}),
                     **({"elastic": el.metrics()} if el is not None else {}),
                     **(controller.heartbeat_fields(state.control)
                        if controller is not None else {}),
@@ -748,6 +769,7 @@ def run(args) -> Dict[str, float]:
                      **fabric_g,
                      **guard_last, **control_stats, **timeline.snapshot(),
                      **(ckpt.metrics() if ckpt is not None else {}),
+                     **(stream.metrics() if stream is not None else {}),
                      **(el.metrics() if el is not None else {}),
                      **fgauges},
                     job_scoped(args, args.prom),
@@ -798,6 +820,8 @@ def run(args) -> Dict[str, float]:
         tb.close()
         if ckpt:
             ckpt.close()   # drains the background writer before events close
+        if stream is not None:
+            stream.close()  # drains the in-flight segment commit
         if events is not None:
             events.close()
         if hb is not None:

@@ -118,11 +118,16 @@ def build_parser() -> argparse.ArgumentParser:
     # robustness: shared --guard*/--chaos/--heartbeat surface
     from tpu_compressed_dp.harness.loop import (add_adaptive_args,
                                                 add_robustness_args,
+                                                add_stream_args,
                                                 add_telemetry_args,
                                                 add_topology_args)
 
     add_topology_args(p)
     add_robustness_args(p, check_note="checked every --log_every")
+    # delta state streaming: shared --stream* surface (stream/)
+    add_stream_args(p, cadence_help="steps between delta-stream appends "
+                                    "(requires --stream_dir; 0 disables "
+                                    "the periodic append)")
     # adaptive compression: shared --adaptive* surface (control/); the LM
     # loop's decision cadence is the --log_every metric-fetch window
     add_adaptive_args(p)
@@ -327,9 +332,10 @@ def run(args) -> Dict[str, float]:
                                                 make_event_stream,
                                                 make_flight_recorder,
                                                 make_heartbeat,
-                                                make_preemption,
+                                                make_preemption, make_stream,
                                                 preempt_exit, profile_trace,
-                                                prom_labels)
+                                                prom_labels,
+                                                stream_rejoin_params)
     from tpu_compressed_dp.obs.export import (telemetry_snapshot,
                                               write_prometheus)
     from tpu_compressed_dp.obs.trace import StepTimeline
@@ -351,6 +357,10 @@ def run(args) -> Dict[str, float]:
     if ckpt is not None:
         ckpt.events = events   # save/rollback records on the run's stream
         ckpt.flight = flight
+    stream = make_stream(args, flight=flight, events=events)
+    if ckpt is not None and stream is not None:
+        # tee: a committed full checkpoint re-anchors the delta window
+        ckpt.stream = stream
     preempt = make_preemption()
     if getattr(args, "elastic", False) and pipelined:
         # dp x sp and dp x tp remesh by deleting the dead DATA row (the
@@ -365,12 +375,16 @@ def run(args) -> Dict[str, float]:
 
     el = build_elastic(args, mesh, chaos=chaos, crash=crash, events=events,
                        place=lambda s, m: place_lm_state(s, cfg, comp, m),
-                       flight=flight, ef_axes=("data", "seq"))
+                       flight=flight, ef_axes=("data", "seq"), stream=stream)
     if el is not None and rejoin is not None:
         # watchdog-relaunched host: adopt the running world's replicated
         # state from the re-elected coordinator's broadcast (EF rows start
-        # at zero) and retrace the step on the post-join mesh
-        state = el.join_world(state, rejoin)
+        # at zero) and retrace the step on the post-join mesh; a warm
+        # joiner replays the delta stream instead of shipping params
+        adopted_params, adopted_info = stream_rejoin_params(
+            args, state, flight=flight)
+        state = el.join_world(state, rejoin, adopted_params=adopted_params,
+                              adopted_info=adopted_info)
         mesh = el.mesh
         dp = el.world
         step_cache.clear()
@@ -470,6 +484,8 @@ def run(args) -> Dict[str, float]:
                                             if guard_cfg is not None else step_i + 1),
                             telemetry=telemetry_snapshot(timeline),
                             **(ckpt.heartbeat_fields() if ckpt is not None
+                               else {}),
+                            **(stream.heartbeat_fields() if stream is not None
                                else {}),
                             **({"elastic": el.metrics()} if el is not None else {}),
                             **(controller.heartbeat_fields(state.control)
@@ -583,6 +599,7 @@ def run(args) -> Dict[str, float]:
                              **thr, **comm_m, **guard_last, **control_stats,
                              **timeline.snapshot(),
                              **(ckpt.metrics() if ckpt is not None else {}),
+                             **(stream.metrics() if stream is not None else {}),
                              **(el.metrics() if el is not None else {}),
                              **fgauges},
                             job_scoped(args, args.prom),
@@ -640,6 +657,12 @@ def run(args) -> Dict[str, float]:
                 # async: snapshot to host, hand the Orbax write to the
                 # background thread, keep stepping
                 ckpt.save_async(state, {"step": step_i + 1})
+            if (stream is not None and args.stream_every > 0
+                    and (step_i + 1) % args.stream_every == 0):
+                # delta stream: Top-K of (params - last streamed) on the
+                # compressed wire codec; codec runs on this thread (window
+                # accounting is ordered), the npz write goes to background
+                stream.append_async(state.params, step=int(state.step))
             step_i += 1
         if ckpt:
             ckpt.save(state, {"step": int(state.step)})
@@ -654,6 +677,8 @@ def run(args) -> Dict[str, float]:
     finally:
         preempt.uninstall()
         prof.close()
+        if stream is not None:
+            stream.close()   # drain the in-flight delta append
         if ckpt:
             ckpt.close()   # drains the background writer before events close
         if events is not None:

@@ -25,9 +25,10 @@ __all__ = ["pad_batch", "run_train_epoch", "run_eval", "train_epoch",
            "fabric_gauges",
            "add_robustness_args", "add_adaptive_args", "add_topology_args",
            "add_telemetry_args", "job_scoped", "prom_labels",
-           "add_checkpoint_args", "build_robustness",
+           "add_checkpoint_args", "add_stream_args", "build_robustness",
            "build_control", "build_elastic", "elastic_distributed_init",
            "make_heartbeat", "make_event_stream", "make_flight_recorder",
+           "make_stream", "stream_join_seq", "stream_rejoin_params",
            "flight_update", "make_preemption",
            "preempt_exit", "profile_trace"]
 
@@ -349,6 +350,91 @@ def add_checkpoint_args(p, *, cadence_help: str) -> None:
     p.add_argument("--ckpt_every", type=int, default=1, help=cadence_help)
 
 
+def add_stream_args(p, *, cadence_help: str) -> None:
+    """The shared ``--stream*`` CLI surface: delta-compressed state
+    streaming (stream/ — incremental checkpoints, warm rejoin, model
+    push).  ``cadence_help`` names the harness's append cadence unit."""
+    p.add_argument("--stream_dir", type=str, default=None,
+                   help="delta state-stream directory (keyframe + Top-K "
+                        "drift segments, manifest-checksummed; feeds warm "
+                        "rejoin and tools/stream_serve.py consumers)")
+    p.add_argument("--stream_every", type=int, default=1, help=cadence_help)
+    p.add_argument("--stream_keyframe_every", type=int, default=8,
+                   help="segments per stream window (one full keyframe, "
+                        "Top-K deltas, one window-closing flush; the flush "
+                        "makes keyframe+deltas == params bitwise)")
+    p.add_argument("--stream_ratio", type=float, default=0.01,
+                   help="Top-K density of each delta segment (fraction of "
+                        "model coordinates)")
+    p.add_argument("--stream_rejoin", action="store_true",
+                   help="on a watchdog relaunch, catch up from the delta "
+                        "stream instead of the survivors' full params "
+                        "broadcast (falls back automatically when the "
+                        "stream is absent or corrupt); requires "
+                        "--stream_dir armed fleet-wide")
+
+
+def make_stream(args, *, flight=None, events=None, log=print):
+    """Resolve ``--stream_dir`` into a started
+    :class:`~tpu_compressed_dp.stream.writer.StreamWriter` (or None).
+    Single-writer discipline: only process 0 appends — every process
+    holds the replicated params, and two writers would race the segment
+    sequence."""
+    if not getattr(args, "stream_dir", None):
+        return None
+    if jax.process_index() != 0:
+        return None
+    from tpu_compressed_dp.stream import StreamWriter
+
+    return StreamWriter(args.stream_dir,
+                        ratio=getattr(args, "stream_ratio", 0.01),
+                        keyframe_every=getattr(args, "stream_keyframe_every",
+                                               8),
+                        flight=flight, events=events, log=log)
+
+
+def stream_join_seq(args):
+    """The joiner's pre-admission stream probe: the segment seq it can
+    catch up to, or None when warm rejoin is off/unavailable.  Passed as
+    ``stream_seq`` into the rendezvous join record so survivors take the
+    params-skipping barrier (``ElasticRuntime.rejoin_barrier``) only for
+    joiners that really can adopt from the stream — the probe runs a full
+    verification catch-up, not just a head read."""
+    if not (getattr(args, "stream_rejoin", False)
+            and getattr(args, "stream_dir", None)):
+        return None
+    from tpu_compressed_dp.stream import (StreamCorrupt, StreamReader,
+                                          is_stream_dir)
+
+    if not is_stream_dir(args.stream_dir):
+        return None
+    try:
+        reader = StreamReader(args.stream_dir)
+        reader.catch_up()
+    except StreamCorrupt as e:
+        print(f"stream: rejoin probe failed ({e}); joining cold")
+        return None
+    return int(reader.applied_seq) if reader.applied_seq >= 0 else None
+
+
+def stream_rejoin_params(args, state, *, flight=None, log=print):
+    """Joiner-side warm rejoin: ``(adopted_params, info)`` for
+    ``ElasticRuntime.join_world``, or ``(None, None)`` to fall back to
+    the survivors' full broadcast.  Runs AFTER admission, so the
+    survivors' barrier flush (``StreamWriter.sync``) is already on disk
+    and the reconstruction is bitwise the live params."""
+    if not (getattr(args, "stream_rejoin", False)
+            and getattr(args, "stream_dir", None)):
+        return None, None
+    from tpu_compressed_dp.stream import warm_rejoin
+
+    adopted, info = warm_rejoin(state, args.stream_dir, log=log,
+                                flight=flight)
+    if info is None:
+        return None, None
+    return adopted.params, info
+
+
 def make_preemption(log=print):
     """Install the SIGTERM/SIGINT preemption flag for a harness run.  Always
     pair with ``handler.uninstall()`` in the run's ``finally``."""
@@ -422,7 +508,7 @@ def build_robustness(args, dtype):
 
 
 def build_elastic(args, mesh, *, chaos=None, crash=None, events=None,
-                  place=None, flight=None, ef_axes=("data",)):
+                  place=None, flight=None, stream=None, ef_axes=("data",)):
     """Resolve the ``--elastic*`` CLI surface into a started
     :class:`~tpu_compressed_dp.train.elastic.ElasticRuntime` (or None).
 
@@ -465,7 +551,7 @@ def build_elastic(args, mesh, *, chaos=None, crash=None, events=None,
     return ElasticRuntime(cfg, mesh, chaos=chaos, gossip=gossip,
                           events=events, place=place, crash=crash,
                           rendezvous=rendezvous, flight=flight,
-                          ef_axes=tuple(ef_axes))
+                          stream=stream, ef_axes=tuple(ef_axes))
 
 
 def elastic_distributed_init(args):
@@ -491,7 +577,8 @@ def elastic_distributed_init(args):
     decision = maybe_rejoin_from_env(
         getattr(args, "elastic_dir", None),
         0 if rank is None else int(rank),
-        deadline_s=4 * getattr(args, "peer_timeout", 60.0))
+        deadline_s=4 * getattr(args, "peer_timeout", 60.0),
+        stream_seq=stream_join_seq(args))
     if decision is not None:
         distributed_init(decision.address, decision.num_processes,
                          decision.process_id)

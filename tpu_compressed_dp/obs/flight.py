@@ -64,8 +64,9 @@ FLIGHT_SCHEMA = 1
 #:   chaos    armed fault-injection specs (what WAS configured to misfire)
 #:   timing   per-phase host spans drained from the StepTimeline
 #:   fault    observed exceptions (the dump trigger trail)
+#:   stream   delta-stream lifecycle (keyframe / flush / warm rejoin)
 CHANNELS = ("step", "guard", "control", "elastic", "ckpt", "chaos",
-            "timing", "fault")
+            "timing", "fault", "stream")
 
 #: exception class name (anywhere in the MRO) -> bundle ``reason``;
 #: matched by NAME so this module imports none of the failure planes

@@ -224,6 +224,40 @@ declare("ckpt/rollback_steps", COUNTER, "steps", "max", "host",
         "steps walked back past corrupt/unreadable checkpoints to reach "
         "the newest verifiable one at restore time")
 
+# --- delta state streaming (stream/; host-side — writer counters on the
+#     training ranks, reader gauges on stream_serve consumers) -----------
+declare("stream/segments", COUNTER, "segments", "max", "host",
+        "delta/keyframe segments committed to the stream directory over "
+        "the writer's lifetime")
+declare("stream/keyframes", COUNTER, "segments", "max", "host",
+        "full-keyframe segments among the committed total (window anchors "
+        "plus forced re-anchors after remesh/checkpoint)")
+declare("stream/bytes", COUNTER, "bytes", "max", "host",
+        "cumulative payload bytes across all committed segments (the "
+        "steady-state stream cost BENCH compares against full "
+        "checkpoint bytes)")
+declare("stream/keyframe_bytes", COUNTER, "bytes", "max", "host",
+        "payload bytes spent on full keyframes (the dense fraction of "
+        "stream/bytes)")
+declare("stream/append_ms", TIMING, "ms", "mean", "host",
+        "commit wall time of the newest segment (payload + digest + "
+        "manifest + head; background thread for append_async)")
+declare("stream/residual_norm", GAUGE, "norm", "mean", "host",
+        "L2 norm of the writer's untransmitted drift (params minus "
+        "last_streamed); exactly 0 after a keyframe or window flush")
+declare("stream/last_step", GAUGE, "steps", "max", "host",
+        "train step of the newest committed segment on the writer, or "
+        "the newest applied segment on a reader (-1 before the first)")
+declare("stream/lag_s", GAUGE, "s", "max", "host",
+        "reader staleness: seconds since the newest applied segment's "
+        "write timestamp (-1 before anything applied)")
+declare("stream/rejoin_bytes", GAUGE, "bytes", "max", "host",
+        "bytes a warm rejoin moved over the delta stream in place of the "
+        "full params broadcast (0 = no warm rejoin yet)")
+declare("stream/corrupt_segments", COUNTER, "segments", "max", "host",
+        "segments a reader rejected at verification (each triggers a "
+        "walk-back to the last keyframe)")
+
 # --- adaptive compression control plane (control/; host-side — every
 #     worker's controller consumes identical psum'd metrics, so values are
 #     identical across workers) -------------------------------------------

@@ -93,7 +93,7 @@ Array = jax.Array
 
 __all__ = ["make_wire_grad_sync", "WIRE_METHODS", "pack_ternary",
            "unpack_ternary", "pack_bits", "unpack_bits", "qsgd_wire_pack",
-           "qsgd_wire_unpack", "packed_indices_monotone"]
+           "qsgd_wire_unpack", "packed_indices_monotone", "select_pack_topk"]
 
 WIRE_METHODS = ("randomk", "topk", "blocktopk", "terngrad", "qsgd",
                 "thresholdv", "adaptive_threshold")
@@ -202,6 +202,24 @@ def _select_pack(flat: Array, mag: Array, t, keep: int):
     mask = mag >= t
     idx = packed_indices_from_mask(mask, keep)
     return _sorted_gather(flat, idx), idx, jnp.sum(mask, dtype=jnp.int32)
+
+
+def select_pack_topk(flat: Array, keep: int):
+    """Top-``keep``-by-magnitude select+pack of a flat vector: the wire
+    compress step (threshold + select + pack, Pallas-fused when
+    dispatched) exposed for non-gradient payloads — the delta stream in
+    :mod:`tpu_compressed_dp.stream` runs it on parameter drift.
+
+    Returns ``(payload [keep], idx [keep] ascending, survivor count)``;
+    when ``count < keep`` (underfull mask — e.g. non-finite inputs)
+    trailing ranks pad with index 0 and callers must trim to
+    ``min(count, keep)``.  Magnitudes are computed internally (``|flat|``
+    in fp32) because the fused kernel recomputes them from ``flat``."""
+    from tpu_compressed_dp.ops import kernels
+
+    mag = jnp.abs(flat).astype(jnp.float32)
+    t = kernels.topk_threshold(mag, keep)
+    return _select_pack(flat, mag, t, keep)
 
 
 def _scatter_combine(shape, dtype, g_idx: Array, g_vals: Array, world,

@@ -1,0 +1,60 @@
+"""Delta-compressed state streaming: incremental checkpoints, warm rejoin,
+and a model-push channel over the compressed wire.
+
+The gradient path moves 10-300x compressed payloads, but until this
+subsystem every state movement — Orbax saves, elastic rejoin adoption,
+any serving replica — shipped FULL parameters.  ``stream/`` reuses the
+wire compressors (:func:`tpu_compressed_dp.ops.wire.select_pack_topk`)
+on **parameter deltas**: each window emits Top-K of
+``params - last_streamed`` with an EF-style host residual ("Sparsified
+SGD with Memory", arxiv 1809.07599), a window-closing flush makes the
+stream lossless — ``keyframe + sum(deltas) == params`` *bitwise* in fp32
+— and periodic full keyframes bound recovery depth.  Every segment is
+manifest-checksummed like the PR 8 checkpoints, so corruption is
+detectable offline (``tools/ckpt_fsck.py``) and at apply time.
+
+Three consumers ride the same segment stream:
+
+  * **incremental checkpoints** — :class:`StreamWriter` appends segments
+    continuously (async, like the Checkpointer's background writer);
+  * **warm rejoin** — a joiner at the rendezvous barrier adopts params
+    from the stream (:func:`warm_rejoin`) instead of a full Orbax
+    restore, and the survivors' barrier flush (:meth:`StreamWriter.sync`)
+    pins the adopted state bitwise to the live params;
+  * **model push** — ``tools/stream_serve.py`` tails the shared dir with
+    a :class:`StreamReader` and applies segments onto read-only
+    eval/serving replicas.
+
+House rules: every module here is replay-deterministic (TCDP101 —
+injectable ``now``/``wall`` clocks only) and every shared-dir commit is
+``<path>.<pid>.tmp`` + ``os.replace`` (TCDP102); all ``stream/*`` stat
+keys are declared in :mod:`tpu_compressed_dp.obs.registry`.
+"""
+
+from tpu_compressed_dp.stream.delta import (apply_delta, flatten_params,
+                                            flush_delta, keep_for_ratio,
+                                            residual_of, topk_delta,
+                                            unflatten_dict, unflatten_like)
+from tpu_compressed_dp.stream.reader import StreamReader
+from tpu_compressed_dp.stream.rejoin import warm_rejoin
+from tpu_compressed_dp.stream.store import (STREAM_SCHEMA, StreamCorrupt,
+                                            head_path, is_stream_dir,
+                                            list_segments, load_segment,
+                                            prune_segments, read_head,
+                                            read_segment_manifest,
+                                            segment_manifest_path,
+                                            segment_payload_path,
+                                            verify_segment, verify_stream,
+                                            write_segment)
+from tpu_compressed_dp.stream.writer import StreamWriter
+
+__all__ = [
+    "STREAM_SCHEMA", "StreamCorrupt", "StreamWriter", "StreamReader",
+    "warm_rejoin", "write_segment", "read_head", "head_path",
+    "is_stream_dir", "list_segments", "load_segment",
+    "read_segment_manifest", "segment_payload_path",
+    "segment_manifest_path", "verify_segment", "verify_stream",
+    "prune_segments", "flatten_params", "unflatten_like", "unflatten_dict",
+    "topk_delta", "flush_delta", "apply_delta", "keep_for_ratio",
+    "residual_of",
+]

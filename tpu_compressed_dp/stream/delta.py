@@ -1,0 +1,174 @@
+"""Delta codec: Top-K of parameter drift on the wire select+pack, with
+set-semantics payloads so reconstruction is *bitwise*.
+
+The trick that makes the stream lossless without fp-summation hazards:
+deltas select coordinates by drift magnitude ``|params - last_streamed|``
+(through :func:`tpu_compressed_dp.ops.wire.select_pack_topk` — the same
+threshold + select + pack chain the gradient wire runs, Pallas-fused
+when dispatched) but transmit the CURRENT VALUES at those coordinates,
+and apply by assignment, never addition.  Setting a float is exact in
+any dtype, so ``last_streamed[idx] = params[idx]`` holds bitwise, the
+host residual ``params - last_streamed`` is exactly zero at every
+transmitted coordinate, and a window-closing flush (every coordinate
+whose BITS differ) makes ``keyframe + sum(deltas) == params`` exact in
+fp32 — the EF-style bounded-residual story of "Sparsified SGD with
+Memory" (arxiv 1809.07599) with equality instead of a bound at window
+boundaries.
+
+Pure functions over host numpy (plus the jitted wire packer); no I/O,
+no clocks — replay-deterministic by construction (TCDP101).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+__all__ = [
+    "flatten_params", "unflatten_like", "unflatten_dict", "keep_for_ratio",
+    "topk_delta", "flush_delta", "apply_delta", "residual_of",
+]
+
+
+def _leaf_paths(params) -> List[str]:
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return [jax.tree_util.keystr(path) for path, _ in leaves]
+
+
+def flatten_params(params) -> Tuple[np.ndarray, List[Dict[str, Any]]]:
+    """Host-flatten a params pytree to one fp32 vector plus its spec
+    (per-leaf path / shape / dtype, in traversal order).  fp32 and
+    narrower float leaves (bf16, fp16) round-trip bitwise through the
+    fp32 cast; the pinned lossless-window invariant is stated in fp32."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(jax.device_get(params))[0]
+    spec = []
+    chunks = []
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        spec.append({"path": jax.tree_util.keystr(path),
+                     "shape": list(arr.shape), "dtype": str(arr.dtype)})
+        chunks.append(arr.astype(np.float32, copy=False).reshape(-1))
+    if not chunks:
+        return np.zeros((0,), np.float32), spec
+    return np.concatenate(chunks), spec
+
+
+def unflatten_like(template_params, vec: np.ndarray,
+                   spec: List[Dict[str, Any]]):
+    """Rebuild a params pytree with the TEMPLATE's structure from a flat
+    vector, checking the stream's spec against the template leaf-for-leaf
+    (path and shape) — a stream from a different model must fail loudly,
+    not scatter into the wrong coordinates."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(
+        jax.device_get(template_params))
+    if len(leaves) != len(spec):
+        raise ValueError(
+            f"stream spec has {len(spec)} leaves, template has "
+            f"{len(leaves)}")
+    out, off = [], 0
+    for (path, leaf), ent in zip(leaves, spec):
+        arr = np.asarray(leaf)
+        key = jax.tree_util.keystr(path)
+        if key != ent["path"] or list(arr.shape) != list(ent["shape"]):
+            raise ValueError(
+                f"stream spec mismatch at {key}: stream has "
+                f"{ent['path']} {ent['shape']}, template {list(arr.shape)}")
+        n = arr.size
+        out.append(vec[off:off + n].astype(arr.dtype).reshape(arr.shape))
+        off += n
+    if off != vec.shape[0]:
+        raise ValueError(f"flat vector has {vec.shape[0]} elements, "
+                         f"template consumes {off}")
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template_params), out)
+
+
+def unflatten_dict(vec: np.ndarray, spec: List[Dict[str, Any]]
+                   ) -> Dict[str, np.ndarray]:
+    """Template-free view for serving consumers: ``{leaf path: array}``
+    in the stream's own dtypes (``tools/stream_serve.py`` snapshots)."""
+    out, off = {}, 0
+    for ent in spec:
+        n = int(np.prod(ent["shape"])) if ent["shape"] else 1
+        out[ent["path"]] = (vec[off:off + n]
+                            .astype(np.dtype(ent["dtype"]))
+                            .reshape(ent["shape"]))
+        off += n
+    return out
+
+
+def keep_for_ratio(n: int, ratio: float) -> int:
+    """Coordinates per Top-K delta for an ``n``-element model."""
+    return max(1, min(int(n), int(round(float(ratio) * int(n)))))
+
+
+def _idx_dtype(n: int) -> np.dtype:
+    """int32 indices halve delta payload cost (8 B/coord with fp32 vals
+    instead of 12); int64 only past 2**31 coordinates per host vector."""
+    return np.int32 if n <= np.iinfo(np.int32).max else np.int64
+
+
+def _changed(vec: np.ndarray, last: np.ndarray) -> np.ndarray:
+    """Indices whose BITS differ — value equality would miss -0.0 vs 0.0
+    and treat NaN as always-changed; the lossless invariant is bitwise."""
+    return np.flatnonzero(vec.view(np.int32) != last.view(np.int32))
+
+
+@functools.lru_cache(maxsize=16)
+def _packer(n: int, keep: int):
+    import jax
+
+    from tpu_compressed_dp.ops import wire
+
+    return jax.jit(functools.partial(wire.select_pack_topk, keep=keep))
+
+
+def topk_delta(vec: np.ndarray, last: np.ndarray, keep: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-``keep``-drift coordinates of ``vec`` vs ``last`` as a
+    ``(idx ascending, current vals fp32)`` set-semantics pair — idx in
+    the narrowest dtype that addresses the vector (see ``_idx_dtype``).
+
+    Selection runs on the wire compress chain (threshold + select+pack);
+    when the bitwise-changed set already fits in ``keep`` the delta is
+    exact and the window converges early."""
+    dt = _idx_dtype(vec.shape[0])
+    changed = _changed(vec, last)
+    if changed.shape[0] <= keep:
+        return changed.astype(dt), vec[changed]
+    payload, idx, count = _packer(vec.shape[0], keep)(vec - last)
+    del payload  # drift magnitudes selected; the VALUES are what we send
+    k = min(int(count), keep)
+    idx = np.asarray(idx)[:k].astype(dt)
+    return idx, vec[idx]
+
+
+def flush_delta(vec: np.ndarray, last: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """The window-closing delta: EVERY bitwise-changed coordinate, so
+    applying it drives the consumer's reconstruction (and the writer's
+    ``last_streamed``) to ``vec`` exactly."""
+    idx = _changed(vec, last).astype(_idx_dtype(vec.shape[0]))
+    return idx, vec[idx]
+
+
+def apply_delta(recon: np.ndarray, idx: np.ndarray, vals: np.ndarray
+                ) -> np.ndarray:
+    """In-place set-semantics apply; returns ``recon``."""
+    recon[idx] = vals
+    return recon
+
+
+def residual_of(vec: np.ndarray, last: np.ndarray) -> np.ndarray:
+    """The EF-style host residual: drift not yet transmitted.  Exactly
+    zero at every transmitted coordinate (set semantics), and bitwise
+    equal to the cumulative drift at untransmitted ones."""
+    return vec - last

@@ -1,0 +1,69 @@
+"""Warm rejoin: adopt params from the delta stream instead of Orbax.
+
+A joiner at the rendezvous barrier normally restores the FULL checkpoint
+before joining.  When a stream directory is live, ``warm_rejoin`` tails
+it with a :class:`~tpu_compressed_dp.stream.reader.StreamReader` and
+substitutes the reconstruction into the joiner's state — moving only
+keyframe + deltas over the shared dir instead of the whole Orbax tree.
+
+Correctness leans on the survivors' side of the protocol: the
+coordinator calls :meth:`StreamWriter.sync` at the rejoin barrier (a
+window-closing flush), so by the time the joiner's catch-up runs, the
+stream head reconstructs to the live params *bitwise* and the barrier's
+params broadcast can be skipped entirely.
+
+Any corruption or missing anchor returns ``None`` — the caller falls
+back to the full restore path, never a half-adopted state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from tpu_compressed_dp.stream.reader import StreamReader
+from tpu_compressed_dp.stream.store import StreamCorrupt, is_stream_dir
+
+__all__ = ["warm_rejoin"]
+
+
+def warm_rejoin(state, stream_dir: str, *, log=print, flight=None
+                ) -> Tuple[Any, Optional[Dict[str, Any]]]:
+    """Catch ``state.params`` up from the segment stream.
+
+    Returns ``(state, info)`` — ``info`` is None when the stream is
+    absent, corrupt, or not anchored (callers fall back to full
+    restore), else ``{"bytes", "segments", "step", "seq", "exact"}``
+    for the rejoin accounting (BENCH rejoin-bytes rows, flight ring).
+    """
+    if not is_stream_dir(stream_dir):
+        return state, None
+    reader = StreamReader(stream_dir, log=log)
+    try:
+        reader.catch_up()
+        params = reader.params_like(state.params)
+    except (StreamCorrupt, ValueError) as e:
+        log(f"[stream] warm rejoin unavailable ({e}); "
+            f"falling back to full restore")
+        if flight is not None:
+            try:
+                flight.record("stream", "warm_rejoin_fallback", error=repr(e))
+            except Exception:
+                pass
+        return state, None
+    info = {
+        "bytes": int(reader.bytes_read),
+        "segments": int(reader.segments_applied),
+        "step": int(reader.applied_step),
+        "seq": int(reader.applied_seq),
+        "exact": bool(reader.exact),
+    }
+    if flight is not None:
+        try:
+            flight.record("stream", "warm_rejoin", **info)
+        except Exception:
+            pass
+    log(f"[stream] warm rejoin: adopted step {info['step']} from "
+        f"{info['segments']} segments ({info['bytes']} bytes, "
+        f"exact={info['exact']})")
+    return dataclasses.replace(state, params=params), info

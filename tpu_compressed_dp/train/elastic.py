@@ -668,7 +668,7 @@ class ElasticRuntime:
                  place: Optional[Callable[[Any, Any], Any]] = None,
                  crash=None, rendezvous=None,
                  ef_axes: Tuple[str, ...] = (DATA_AXIS,),
-                 flight=None,
+                 flight=None, stream=None,
                  log: Callable[[str], None] = print):
         _mesh_grid(mesh)  # validates the mesh shape up front
         self.cfg = cfg
@@ -692,6 +692,12 @@ class ElasticRuntime:
         # multi-process coordinated re-init path; None keeps every remesh
         # in-process (the single-process simulation and all the drills)
         self.rendezvous = rendezvous
+        # the delta StreamWriter (stream/writer.py): every committed world
+        # transition requests a keyframe (the delta window re-anchors on
+        # the new membership) and the rejoin barrier flushes the stream so
+        # a joiner catching up from it adopts the live params bitwise
+        self.stream = stream
+        self.stream_rejoin_bytes = 0.0     # newest warm rejoin's byte cost
         # which mesh axes the gradient sync spans — the EF leading axis
         # layout (the LM harness passes ('data', 'seq'))
         self.ef_axes = tuple(ef_axes)
@@ -870,6 +876,7 @@ class ElasticRuntime:
                 ef_policy=self.cfg.ef_policy,
                 dropped_ef_norm=float(dropped),
                 latency_ms=self.remesh_latency_ms)
+        self._stream_keyframe()
         return state
 
     # -- re-admission ----------------------------------------------------
@@ -901,7 +908,19 @@ class ElasticRuntime:
         if self.flight is not None:
             self.flight.record("elastic", "readmit", ranks=ranks,
                                world=self.world)
+        self._stream_keyframe()
         return state
+
+    def _stream_keyframe(self) -> None:
+        """Re-anchor the delta stream after a committed world transition —
+        a consumer must never need segments that straddle a membership
+        change to reconstruct the post-transition state."""
+        st = self.stream
+        if st is not None:
+            try:
+                st.request_keyframe()
+            except Exception:
+                pass  # the stream tee must never fail a remesh
 
     @property
     def parked(self) -> Tuple[int, ...]:
@@ -1051,7 +1070,19 @@ class ElasticRuntime:
         re-init, and rebuild with zero EF rows for the joiners (their rows
         arrive via each process's local contribution — the joiner's own
         :meth:`join_world` supplies zeros).  Returns ``(state, changed)``;
-        the caller rebuilds its jitted steps when ``changed``."""
+        the caller rebuilds its jitted steps when ``changed``.
+
+        Warm rejoin: when EVERY pending joiner's join record carries the
+        ``stream`` flag (it caught up from the delta stream —
+        :func:`tpu_compressed_dp.stream.rejoin.warm_rejoin`) and this
+        runtime has a :class:`StreamWriter`, the barrier flushes the
+        stream first (:meth:`StreamWriter.sync` — the head now
+        reconstructs to the live params bitwise) and the broadcast SKIPS
+        the params tree: the joiners already hold it, and the dominant
+        rejoin byte cost moves from the full dense params onto the
+        compressed delta wire.  Both sides must agree on the layout, so
+        ``--stream_dir`` has to be armed fleet-wide or not at all (the
+        joiner only sets the flag after a successful catch-up)."""
         if self.rendezvous is None or jax.process_count() <= 1:
             return state, False
         joins = self.rendezvous.pending_joins()
@@ -1059,7 +1090,14 @@ class ElasticRuntime:
         if not ready:
             return state, False
         t0 = time.monotonic()
+        warm = (self.stream is not None
+                and all(joins[r].get("stream") is not None for r in ready))
         repl, local_ef, local_comp = self._host_snapshot(state)
+        if warm:
+            # pin stream == live params before the epoch commit: the
+            # joiners' adopted reconstruction is bitwise what the
+            # survivors hold, so skipping the params broadcast is safe
+            self.stream.sync(repl.params, step=int(repl.step))
         new_ranks = sorted(set(self._proc_ranks) | set(ready))
         from jax.experimental import multihost_utils
 
@@ -1072,8 +1110,15 @@ class ElasticRuntime:
             deadline_s=self.cfg.peer_timeout_s * 4)
         reinit_distributed(decision, log=self._log)
         src = decision.ranks.index(decision.coordinator)
-        repl = multihost_utils.broadcast_one_to_all(
-            repl, is_source=decision.process_id == src)
+        if warm:
+            params_local = repl.params
+            bx = multihost_utils.broadcast_one_to_all(
+                dataclasses.replace(repl, params=()),
+                is_source=decision.process_id == src)
+            repl = dataclasses.replace(bx, params=params_local)
+        else:
+            repl = multihost_utils.broadcast_one_to_all(
+                repl, is_source=decision.process_id == src)
         if local_comp != ():
             # comp rows are identical across workers by construction, so
             # the coordinator's local rows re-warm the joiners' too
@@ -1097,18 +1142,28 @@ class ElasticRuntime:
                   f"{ready} -> world {self.world}")
         if self.events is not None:
             self.events.emit("readmit", ranks=ready, world=self.world,
-                             epoch=decision.epoch)
+                             epoch=decision.epoch, warm=warm)
         if self.flight is not None:
             self.flight.record("elastic", "readmit", ranks=ready,
-                               world=self.world, epoch=decision.epoch)
+                               world=self.world, epoch=decision.epoch,
+                               warm=warm)
+        self._stream_keyframe()
         return state, True
 
-    def join_world(self, state, decision):
+    def join_world(self, state, decision, *, adopted_params=None,
+                   adopted_info=None):
         """Joiner half of multi-process scale-up: called by a relaunched
         harness right after init, with the :class:`EpochDecision` its
         rendezvous join returned.  The fresh-init state supplies shapes;
         replicated values are adopted from the survivors' broadcast and
-        the EF rows start at zero (a rejoiner has withheld nothing)."""
+        the EF rows start at zero (a rejoiner has withheld nothing).
+
+        ``adopted_params`` is the warm-rejoin reconstruction
+        (:func:`tpu_compressed_dp.stream.rejoin.warm_rejoin`): when set,
+        the params tree is taken from the stream instead of the barrier
+        broadcast — matching the survivors' params-skipping layout (they
+        see our ``stream`` join flag).  ``adopted_info`` is that
+        rejoin's accounting dict (bytes/segments/step)."""
         from jax.experimental import multihost_utils
 
         repl, local_ef, local_comp = self._host_snapshot(state)
@@ -1116,8 +1171,21 @@ class ElasticRuntime:
         # for every replicated field and the comp re-warm; our fresh-init
         # values are discarded
         src = decision.ranks.index(decision.coordinator)
-        repl = multihost_utils.broadcast_one_to_all(
-            repl, is_source=decision.process_id == src)
+        if adopted_params is not None:
+            repl = dataclasses.replace(repl, params=adopted_params)
+            bx = multihost_utils.broadcast_one_to_all(
+                dataclasses.replace(repl, params=()),
+                is_source=decision.process_id == src)
+            repl = dataclasses.replace(bx, params=repl.params)
+            self.stream_rejoin_bytes = float(
+                (adopted_info or {}).get("bytes", 0))
+            if self.flight is not None:
+                self.flight.record("stream", "warm_join",
+                                   epoch=decision.epoch,
+                                   **dict(adopted_info or {}))
+        else:
+            repl = multihost_utils.broadcast_one_to_all(
+                repl, is_source=decision.process_id == src)
         if local_comp != ():
             local_comp = multihost_utils.broadcast_one_to_all(
                 local_comp, is_source=decision.process_id == src)
@@ -1145,4 +1213,5 @@ class ElasticRuntime:
             "elastic/dropped_ef_norm": float(self.dropped_ef_norm),
             "elastic/remesh_latency_ms": float(self.remesh_latency_ms),
             "elastic/remesh_ms": float(self.remesh_ms),
+            "stream/rejoin_bytes": float(self.stream_rejoin_bytes),
         }

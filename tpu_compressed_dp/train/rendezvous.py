@@ -274,10 +274,17 @@ class Rendezvous:
     def _join_path(self, rank: int) -> str:
         return os.path.join(self.dir, f"join.rank{int(rank)}.json")
 
-    def request_join(self, *, incarnation: int = 0) -> None:
-        _write_json(self._join_path(self.rank), {
-            "rank": self.rank, "incarnation": int(incarnation),
-            "host": self.host, "ts": self._wall()})
+    def request_join(self, *, incarnation: int = 0,
+                     stream_seq: Optional[int] = None) -> None:
+        rec = {"rank": self.rank, "incarnation": int(incarnation),
+               "host": self.host, "ts": self._wall()}
+        if stream_seq is not None:
+            # warm rejoin: this joiner caught up from the delta stream
+            # through segment `stream_seq` — survivors reading the flag
+            # flush the stream and skip the params broadcast
+            # (ElasticRuntime.rejoin_barrier)
+            rec["stream"] = int(stream_seq)
+        _write_json(self._join_path(self.rank), rec)
 
     def pending_joins(self) -> Dict[int, dict]:
         """Relaunched hosts waiting for admission (rank -> join record)."""
@@ -297,14 +304,17 @@ class Rendezvous:
 
     def join(self, *, incarnation: int = 0,
              stale_epoch: Optional[int] = None,
-             deadline_s: float = 60.0) -> Optional[EpochDecision]:
+             deadline_s: float = 60.0,
+             stream_seq: Optional[int] = None) -> Optional[EpochDecision]:
         """A relaunched host's admission wait: announce, then poll for a
         commit that names this rank.  ``stale_epoch`` is the epoch the
         relaunch env advertised — the world this process DIED out of; only
         a strictly newer commit admits (the stale epoch file may still
-        list us).  Returns None on deadline (park-and-retry: the join file
-        stays behind, the caller exits, the watchdog retries)."""
-        self.request_join(incarnation=incarnation)
+        list us).  ``stream_seq`` advertises a warm rejoin (see
+        :meth:`request_join`).  Returns None on deadline (park-and-retry:
+        the join file stays behind, the caller exits, the watchdog
+        retries)."""
+        self.request_join(incarnation=incarnation, stream_seq=stream_seq)
         deadline = self._now() + float(deadline_s)
         while True:
             rec = self.current()
@@ -333,6 +343,7 @@ def export_env(env: dict, rec: dict) -> dict:
 def maybe_rejoin_from_env(rdzv_dir: Optional[str], rank: int, *,
                           deadline_s: float = 300.0,
                           env: Optional[dict] = None,
+                          stream_seq: Optional[int] = None,
                           **rdzv_kw) -> Optional[EpochDecision]:
     """The relaunched harness's entry: if the environment carries a
     rendezvous epoch (the watchdog saw a running world when it respawned
@@ -357,7 +368,7 @@ def maybe_rejoin_from_env(rdzv_dir: Optional[str], rank: int, *,
         incarnation = 0
     rdzv = Rendezvous(rdzv_dir, rank, **rdzv_kw)
     decision = rdzv.join(incarnation=incarnation, stale_epoch=stale_epoch,
-                         deadline_s=deadline_s)
+                         deadline_s=deadline_s, stream_seq=stream_seq)
     if decision is None:
         raise RendezvousTimeout(
             f"rank {rank} not admitted within {deadline_s:g}s — parking "

@@ -54,7 +54,7 @@ from tpu_compressed_dp.train.state import TrainState
 __all__ = [
     "Checkpointer", "CheckpointCorrupt", "save_checkpoint",
     "restore_checkpoint", "MANIFEST_SCHEMA", "manifest_path", "read_manifest",
-    "write_manifest", "verify_step_dir", "list_step_dirs",
+    "write_manifest", "verify_step_dir", "list_step_dirs", "digest_file",
 ]
 
 #: manifest schema version; bump on incompatible manifest layout changes
@@ -77,12 +77,17 @@ def manifest_path(directory: str, step: int) -> str:
     return os.path.join(directory, f"manifest-{int(step)}.json")
 
 
-def _digest_file(path: str) -> str:
+def digest_file(path: str) -> str:
+    """Chunked SHA-256 of one file — the digest every manifest entry (and
+    the stream segment store, :mod:`tpu_compressed_dp.stream.store`) pins."""
     h = hashlib.sha256()
     with open(path, "rb") as f:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+_digest_file = digest_file  # internal callers / historical name
 
 
 def write_manifest(directory: str, step: int,
@@ -199,6 +204,11 @@ class Checkpointer:
         self.best_step: Optional[int] = None
         self.events = events
         self.flight = flight
+        #: optional :class:`tpu_compressed_dp.stream.writer.StreamWriter`
+        #: tee — each committed full checkpoint requests a stream keyframe
+        #: so the next delta window re-anchors at a durably-saved state
+        #: (recovery depth for a stream consumer never spans a checkpoint)
+        self.stream = None
         #: last background write failure popped by a non-raising barrier
         self.last_save_error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
@@ -346,6 +356,12 @@ class Checkpointer:
         if meta.get("emergency"):
             fields["emergency"] = True
         self._emit("ckpt_save", **fields)
+        st = self.stream
+        if st is not None:
+            try:
+                st.request_keyframe()  # re-anchor the delta window here
+            except Exception:
+                pass  # the stream tee must never fail a save
 
     def _gc(self) -> None:
         """Keep the newest ``max_to_keep`` steps plus the pinned best step.

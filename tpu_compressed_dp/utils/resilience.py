@@ -284,6 +284,7 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
                     max_wedge_steps: Optional[int] = None,
                     min_steps_per_sec: Optional[float] = None,
                     max_ckpt_age_s: Optional[float] = None,
+                    max_stream_lag_s: Optional[float] = None,
                     max_straggler_skew_s: Optional[float] = None,
                     now: Optional[float] = None,
                     hb: Optional[Dict[str, Any]] = None) -> list:
@@ -309,6 +310,11 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
       exceeds ``max_ckpt_age_s``: training advances but nothing durable is
       landing — a wedged async writer or a full/readonly checkpoint disk,
       the failure a crash would silently amplify into lost work.
+    * **stream-stale** — ``stream_lag_s`` (written from
+      ``StreamWriter.heartbeat_fields``, or by ``tools/stream_serve.py``
+      on the consumer side) plus the heartbeat's own age exceeds
+      ``max_stream_lag_s``: the delta state stream has stopped advancing —
+      warm rejoin and the model-push channel are serving stale parameters.
     * **straggler** — ``straggler_skew_s`` (the flight recorder's live
       cross-rank skew of the mean host step time, from
       ``FlightRecorder.publish``) exceeds ``max_straggler_skew_s``: one
@@ -358,6 +364,16 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
                 f"(> {max_ckpt_age_s:g}s, last_ckpt_step="
                 f"{hb.get('last_ckpt_step')}) — a crash now loses that much "
                 "work")
+    if max_stream_lag_s is not None and hb.get("stream_lag_s") is not None:
+        # same heartbeat-age correction as the checkpoint clock: a dying
+        # writer must not freeze the stream lag at a healthy value
+        lag = float(hb["stream_lag_s"]) + max(age, 0.0)
+        if lag > max_stream_lag_s:
+            problems.append(
+                f"stream stale: last delta segment {lag:.1f}s ago "
+                f"(> {max_stream_lag_s:g}s, stream_last_step="
+                f"{hb.get('stream_last_step')}) — warm rejoin and serving "
+                "consumers are falling behind the run")
     skew = hb.get("straggler_skew_s", tele.get("straggler_skew_s"))
     if max_straggler_skew_s is not None and skew is not None:
         if float(skew) > max_straggler_skew_s:
