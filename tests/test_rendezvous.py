@@ -141,6 +141,23 @@ class TestPropose:
         # rank 2 never voted, yet is in the committed world
         assert 2 not in r0.read_votes(1)
 
+    def test_warm_bit_is_committed_and_defaults_false(self, tmp_path):
+        """The readmission barrier's warm-rejoin layout bit rides in the
+        epoch COMMIT: every participant — voter or parked joiner — reads
+        the same bit back and picks the broadcast layout from it, and a
+        record without one (pre-stream epochs, the failure path) decodes
+        cold."""
+        clock = FakeClock()
+        r0 = make(tmp_path, 0, clock)
+        d = r0.propose([0], warm=True)
+        assert d.warm
+        # a parked joiner decodes the committed record the same way
+        assert r0.decision_from(read_epoch(str(tmp_path))).warm
+        assert not r0.propose([0]).warm          # cold is the default
+        write_epoch(str(tmp_path), {"epoch": 9, "ranks": [0],
+                                    "coordinator": 0, "address": "h:1"})
+        assert not r0.decision_from(read_epoch(str(tmp_path))).warm
+
     def test_conflicting_votes_are_split_brain(self, tmp_path):
         clock = FakeClock()
         r0 = make(tmp_path, 0, clock)

@@ -24,9 +24,10 @@ import pytest
 from tpu_compressed_dp.stream import delta as sdelta
 from tpu_compressed_dp.stream.reader import StreamReader
 from tpu_compressed_dp.stream.rejoin import warm_rejoin
-from tpu_compressed_dp.stream.store import (StreamCorrupt, is_stream_dir,
-                                            list_segments, prune_segments,
-                                            read_head, read_segment_manifest,
+from tpu_compressed_dp.stream.store import (StreamCorrupt, head_path,
+                                            is_stream_dir, list_segments,
+                                            prune_segments, read_head,
+                                            read_segment_manifest,
                                             segment_payload_path,
                                             verify_stream)
 from tpu_compressed_dp.stream.writer import StreamWriter
@@ -252,6 +253,41 @@ class TestWindowInvariant:
         _assert_bitwise(params, r.params_like(params), "resume keyframe")
         w2.close()
 
+    def test_reopen_never_overwrites_committed_but_unheaded_segment(
+            self, tmp_path):
+        """write_segment commits payload -> manifest -> head; a crash
+        between the last two leaves a committed segment the head pointer
+        never saw.  A restarted writer must continue PAST it — overwriting
+        it would make a tailing reader (which already scanned that seq)
+        skip the replacement keyframe and apply later deltas onto a wrong
+        base while still reporting exact."""
+        sd = str(tmp_path / "stream")
+        rng = np.random.RandomState(17)
+        params = _params(rng)
+        w = StreamWriter(sd, ratio=0.05, keyframe_every=4, log=_quiet)
+        for step in (1, 2, 3):
+            w.append(params, step=step)
+            params = _advance(params, rng)
+        w.close()
+        # roll the head pointer one seq back: the on-disk picture a crash
+        # between the manifest and head commits leaves behind
+        head = read_head(sd)
+        with open(head_path(sd), "w") as f:
+            json.dump({**head, "seq": head["seq"] - 1}, f)
+        # a long-lived tailing reader has already scanned seq 2
+        r = StreamReader(sd, log=_quiet)
+        r.catch_up()
+        assert r.applied_seq == 2
+        w2 = StreamWriter(sd, ratio=0.05, keyframe_every=4, log=_quiet)
+        seq = w2.append(params, step=4)
+        assert seq == 3, "restart must not reuse the unheaded seq 2"
+        assert read_segment_manifest(sd, 3)["kind"] == "keyframe"
+        r.catch_up()
+        _assert_bitwise(params, r.params_like(params),
+                        "tailing reader across a torn-head restart")
+        assert r.exact
+        w2.close()
+
     def test_request_keyframe_re_anchors(self, tmp_path):
         sd = str(tmp_path / "stream")
         rng = np.random.RandomState(8)
@@ -337,6 +373,30 @@ class TestStoreAndFsck:
         r2.catch_up()
         assert r2.corrupt_segments == 1      # met seq 6 scanning forward
         assert r2.applied_seq == 3 and not r2.exact
+
+    def test_torn_head_never_claims_exact_while_behind(self, tmp_path):
+        """``exact`` on an unreadable head pointer falls back to the
+        committed-segment listing: a reader a window behind must not
+        label its snapshot bitwise-at-head just because the head tore."""
+        sd = str(tmp_path / "stream")
+        rng = np.random.RandomState(19)
+        params = _params(rng)
+        w = StreamWriter(sd, ratio=0.05, keyframe_every=4, log=_quiet)
+        w.append(params, step=1)
+        p2 = _advance(params, rng)
+        w.sync(p2, step=2)
+        r = StreamReader(sd, log=_quiet)
+        r.catch_up()
+        assert r.exact
+        p3 = _advance(p2, rng)
+        w.sync(p3, step=3)               # reader now one flush behind
+        with open(head_path(sd), "w") as f:
+            f.write("{torn")
+        assert not r.exact               # behind + torn head != exact
+        r.catch_up()
+        assert r.exact                   # caught up: listing fallback
+        _assert_bitwise(p3, r.params_like(p3), "post-tear catch-up")
+        w.close()
 
     def test_no_verifiable_keyframe_raises(self, tmp_path):
         sd, _ = self._stream(tmp_path, n=2, keyframe_every=4)
@@ -523,7 +583,13 @@ class TestWarmRejoinEndToEnd:
             joins = surv.pending_joins()
             if 0 in joins and "d" not in committed:
                 assert joins[0]["stream"] == w.head_seq
-                committed["d"] = surv.propose([0, 1], voters=[1])
+                # the survivors derive warm from the immutable join
+                # records (+ the fleet-wide armed flag) and PUBLISH the
+                # bit in the commit — both sides of the admission
+                # broadcast pick their layout from the committed record
+                committed["d"] = surv.propose(
+                    [0, 1], voters=[1],
+                    warm=joins[0].get("stream") is not None)
 
         # -- warm joiner: adopt from the stream; Orbax must not be read
         fresh, _ = chaos_drill._tiny_setup(mesh8, comp, None, None)
@@ -543,6 +609,7 @@ class TestWarmRejoinEndToEnd:
         decision = joiner_rdzv.join(incarnation=1, stream_seq=info["seq"],
                                     deadline_s=30.0)
         assert decision is not None and decision.ranks == (0, 1)
+        assert decision.warm, "commit must carry the warm layout bit"
         monkeypatch.setattr(
             ck.Checkpointer, "restore",
             lambda *a, **k: (_ for _ in ()).throw(
@@ -563,16 +630,25 @@ class TestWarmRejoinEndToEnd:
         _assert_bitwise(live_params, jax.device_get(warm_state.params),
                         "warm joiner vs survivor")
 
-        # -- control joiner: full Orbax restore, same barrier
+        # -- control joiner: full Orbax restore under a COLD commit (the
+        # layout a fleet without unanimous stream flags agrees on)
         fresh2, _ = chaos_drill._tiny_setup(mesh8, comp, None, None)
         restore = ck.Checkpointer(cd)
         cold, _meta = restore.restore(fresh2)
         restore.close()
         el2 = ElasticRuntime(ElasticConfig(), mesh8, log=_quiet)
-        cold_state = el2.join_world(raw_rng(cold), decision)
+        cold_state = el2.join_world(
+            raw_rng(cold), dataclasses.replace(decision, warm=False))
         _assert_bitwise(jax.device_get(cold_state.params),
                         jax.device_get(warm_state.params),
                         "warm joiner vs full-restore joiner")
+
+        # a warm commit with no adoption in hand must refuse to join the
+        # params-skipping collective (fresh-init params would be garbage)
+        from tpu_compressed_dp.train.rendezvous import RendezvousError
+        el3 = ElasticRuntime(ElasticConfig(), mesh8, log=_quiet)
+        with pytest.raises(RendezvousError):
+            el3.join_world(raw_rng(fresh2), decision)
         w.close()
 
 
@@ -618,6 +694,38 @@ class TestHarnessPlumbing:
         # an unusable stream degrades to a cold join, not a crash
         _flip_payload(sd, 0)
         assert loop.stream_join_seq(a) is None
+
+    def test_rejoin_params_respects_cold_commit(self, tmp_path):
+        """The joiner's catch-up obeys the COMMITTED warm bit: a cold
+        admission skips the stream outright (the survivors take the full
+        broadcast layout, so an adoption would be discarded anyway)."""
+        from tpu_compressed_dp.harness.loop import stream_rejoin_params
+        from tpu_compressed_dp.train.rendezvous import EpochDecision
+
+        sd = str(tmp_path / "s")
+        rng = np.random.RandomState(21)
+        w = StreamWriter(sd, ratio=0.05, keyframe_every=4, log=_quiet)
+        w.sync(_params(rng), step=1)
+        w.close()
+        a = self._args(["--stream_dir", sd, "--stream_rejoin"])
+        cold = EpochDecision(epoch=1, ranks=(0, 1), coordinator=1,
+                             address="h:1", process_id=0, warm=False)
+        assert stream_rejoin_params(a, None, cold, log=_quiet) == (None,
+                                                                   None)
+
+    def test_elastic_runtime_warm_layout_is_fleet_shared(self, mesh8):
+        """The barrier layout keys on ``stream_armed`` (a fleet-wide
+        fact), never on holding the writer: a survivor WITHOUT the
+        process-0 StreamWriter must still compute the warm layout."""
+        from tpu_compressed_dp.train.elastic import (ElasticConfig,
+                                                     ElasticRuntime)
+
+        el = ElasticRuntime(ElasticConfig(), mesh8, log=_quiet,
+                            stream=None, stream_armed=True)
+        assert el.stream_armed and el.stream is None
+        # directly-constructed runtimes (drills) follow the writer
+        assert not ElasticRuntime(ElasticConfig(), mesh8,
+                                  log=_quiet).stream_armed
 
     def test_all_harnesses_expose_stream_flags(self):
         for mod in ("dawn", "imagenet", "lm"):

@@ -504,11 +504,12 @@ def run(args) -> dict:
         # watchdog-relaunched host: adopt the running world's replicated
         # state from the re-elected coordinator's broadcast (EF rows start
         # at zero) and retrace the steps on the post-join mesh.  With
-        # --stream_rejoin the params come off the delta stream instead of
-        # the broadcast (the survivors' barrier flushed it bitwise-equal
-        # to the live params before admitting us).
+        # --stream_rejoin and a warm-committed epoch the params come off
+        # the delta stream instead of the broadcast (the survivors'
+        # barrier flushed it bitwise-equal to the live params before
+        # admitting us, and published the warm bit in the commit).
         adopted_params, adopted_info = stream_rejoin_params(
-            args, state, flight=flight)
+            args, state, rejoin, flight=flight)
         state = el.join_world(state, rejoin, adopted_params=adopted_params,
                               adopted_info=adopted_info)
         mesh, ndev = el.mesh, el.world

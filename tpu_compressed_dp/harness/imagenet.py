@@ -509,7 +509,7 @@ def run(args) -> Dict[str, float]:
         # rebuilt against the post-join one.  With --stream_rejoin the
         # params adopt from the delta stream, not the broadcast.
         adopted_params, adopted_info = stream_rejoin_params(
-            args, state, flight=flight)
+            args, state, rejoin, flight=flight)
         state = el.join_world(state, rejoin, adopted_params=adopted_params,
                               adopted_info=adopted_info)
         mesh, ndev = el.mesh, el.world

@@ -382,7 +382,7 @@ def run(args) -> Dict[str, float]:
         # at zero) and retrace the step on the post-join mesh; a warm
         # joiner replays the delta stream instead of shipping params
         adopted_params, adopted_info = stream_rejoin_params(
-            args, state, flight=flight)
+            args, state, rejoin, flight=flight)
         state = el.join_world(state, rejoin, adopted_params=adopted_params,
                               adopted_info=adopted_info)
         mesh = el.mesh

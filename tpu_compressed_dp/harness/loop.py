@@ -417,14 +417,23 @@ def stream_join_seq(args):
     return int(reader.applied_seq) if reader.applied_seq >= 0 else None
 
 
-def stream_rejoin_params(args, state, *, flight=None, log=print):
+def stream_rejoin_params(args, state, decision=None, *, flight=None,
+                         log=print):
     """Joiner-side warm rejoin: ``(adopted_params, info)`` for
-    ``ElasticRuntime.join_world``, or ``(None, None)`` to fall back to
-    the survivors' full broadcast.  Runs AFTER admission, so the
-    survivors' barrier flush (``StreamWriter.sync``) is already on disk
-    and the reconstruction is bitwise the live params."""
+    ``ElasticRuntime.join_world``, or ``(None, None)`` to take the
+    survivors' full broadcast.  Runs AFTER admission, so the survivors'
+    barrier flush (``StreamWriter.sync``) is already on disk and the
+    reconstruction is bitwise the live params.  ``decision`` is the
+    :class:`~tpu_compressed_dp.train.rendezvous.EpochDecision` the join
+    returned: its committed ``warm`` bit is the fleet-wide agreement on
+    the broadcast layout, so when it says cold the catch-up is skipped
+    outright (``join_world`` would discard it anyway)."""
     if not (getattr(args, "stream_rejoin", False)
             and getattr(args, "stream_dir", None)):
+        return None, None
+    if decision is not None and not getattr(decision, "warm", False):
+        log("stream: epoch committed a cold admission — skipping the "
+            "warm-rejoin catch-up")
         return None, None
     from tpu_compressed_dp.stream import warm_rejoin
 
@@ -548,10 +557,16 @@ def build_elastic(args, mesh, *, chaos=None, crash=None, events=None,
         if jax.process_count() > 1:
             from tpu_compressed_dp.train.rendezvous import Rendezvous
             rendezvous = Rendezvous(cfg.gossip_dir, cfg.rank)
+    # stream_armed is the FLEET-WIDE fact (--stream_dir is the same CLI on
+    # every process); self.stream is held by process 0 only (make_stream),
+    # so the warm-rejoin barrier layout must key on the former
     return ElasticRuntime(cfg, mesh, chaos=chaos, gossip=gossip,
                           events=events, place=place, crash=crash,
                           rendezvous=rendezvous, flight=flight,
-                          stream=stream, ef_axes=tuple(ef_axes))
+                          stream=stream,
+                          stream_armed=bool(getattr(args, "stream_dir",
+                                                    None)),
+                          ef_axes=tuple(ef_axes))
 
 
 def elastic_distributed_init(args):

@@ -180,7 +180,13 @@ class StreamReader:
         if not self._anchored or self._vec is None:
             return False
         head = store.read_head(self.directory)
-        return head is None or int(head["seq"]) <= self._applied_seq
+        if head is not None:
+            return int(head["seq"]) <= self._applied_seq
+        # no/torn head pointer: it is also what a mid-rewrite or damaged
+        # stream looks like, so claim exactness only against the committed
+        # segments actually on disk — never by default
+        seqs = store.list_segments(self.directory)
+        return bool(seqs) and seqs[-1] <= self._applied_seq
 
     def params_like(self, template_params):
         """The reconstruction as a pytree with the TEMPLATE's structure

@@ -35,9 +35,11 @@ class StreamWriter:
     ``ratio`` is the Top-K density per delta (fraction of model
     coordinates); ``keyframe_every`` is the window length in segments
     (one keyframe, ``keyframe_every - 2`` Top-K deltas, one flush).
-    On restart over an existing stream the sequence continues from the
-    on-disk head and the first append is forced to a keyframe — the new
-    writer has no ``last_streamed`` to delta against.
+    On restart over an existing stream the sequence continues past the
+    newest committed segment (manifest or head pointer, whichever is
+    newer — a crash can commit a manifest the head never saw) and the
+    first append is forced to a keyframe — the new writer has no
+    ``last_streamed`` to delta against.
 
     Set ``.flight`` / ``.events`` (or pass them) the way the
     Checkpointer's are set to tee keyframe/flush lifecycle into the
@@ -70,10 +72,19 @@ class StreamWriter:
         self._since_keyframe = 0
         self._keyframe_seq = -1
         self._force_keyframe = False
+        # continue past the newest COMMITTED segment, not just the head
+        # pointer: write_segment commits payload -> manifest -> head, so a
+        # crash between the manifest and head replaces leaves a committed
+        # segment at head.seq+1 that a head-only restart would silently
+        # overwrite — and a tailing reader that already scanned that seq
+        # would skip the replacement keyframe and delta off a wrong base
         head = store.read_head(self.directory)
-        if head is not None:
+        seqs = store.list_segments(self.directory)
+        last = max(int(head["seq"]) if head is not None else -1,
+                   seqs[-1] if seqs else -1)
+        if last >= 0:
             # continue the on-disk sequence; the first append must anchor
-            self._seq = int(head["seq"]) + 1
+            self._seq = last + 1
             self._force_keyframe = True
         else:
             self._seq = 0

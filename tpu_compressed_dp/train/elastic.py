@@ -668,7 +668,7 @@ class ElasticRuntime:
                  place: Optional[Callable[[Any, Any], Any]] = None,
                  crash=None, rendezvous=None,
                  ef_axes: Tuple[str, ...] = (DATA_AXIS,),
-                 flight=None, stream=None,
+                 flight=None, stream=None, stream_armed=None,
                  log: Callable[[str], None] = print):
         _mesh_grid(mesh)  # validates the mesh shape up front
         self.cfg = cfg
@@ -697,6 +697,15 @@ class ElasticRuntime:
         # the new membership) and the rejoin barrier flushes the stream so
         # a joiner catching up from it adopts the live params bitwise
         self.stream = stream
+        # whether the delta stream is armed FLEET-WIDE (``--stream_dir``
+        # on every process).  The writer itself lives only on process 0,
+        # so the warm-rejoin barrier layout must key on this flag — a
+        # value every survivor shares — never on ``self.stream`` (which
+        # would make process 0 pick a different collective pytree than
+        # the other survivors).  Defaults to following ``stream`` for
+        # single-writer setups constructed directly (tests, drills).
+        self.stream_armed = (stream is not None if stream_armed is None
+                             else bool(stream_armed))
         self.stream_rejoin_bytes = 0.0     # newest warm rejoin's byte cost
         # which mesh axes the gradient sync spans — the EF leading axis
         # layout (the LM harness passes ('data', 'seq'))
@@ -1072,17 +1081,20 @@ class ElasticRuntime:
         :meth:`join_world` supplies zeros).  Returns ``(state, changed)``;
         the caller rebuilds its jitted steps when ``changed``.
 
-        Warm rejoin: when EVERY pending joiner's join record carries the
-        ``stream`` flag (it caught up from the delta stream —
-        :func:`tpu_compressed_dp.stream.rejoin.warm_rejoin`) and this
-        runtime has a :class:`StreamWriter`, the barrier flushes the
-        stream first (:meth:`StreamWriter.sync` — the head now
-        reconstructs to the live params bitwise) and the broadcast SKIPS
-        the params tree: the joiners already hold it, and the dominant
-        rejoin byte cost moves from the full dense params onto the
-        compressed delta wire.  Both sides must agree on the layout, so
-        ``--stream_dir`` has to be armed fleet-wide or not at all (the
-        joiner only sets the flag after a successful catch-up)."""
+        Warm rejoin: when the delta stream is armed FLEET-WIDE
+        (``stream_armed`` — ``--stream_dir`` on every process) and EVERY
+        pending joiner's join record carries the ``stream`` flag (it
+        caught up from the delta stream —
+        :func:`tpu_compressed_dp.stream.rejoin.warm_rejoin`), the barrier
+        flushes the stream first (:meth:`StreamWriter.sync`, on the one
+        process that holds the writer — the head now reconstructs to the
+        live params bitwise), publishes the warm bit in the epoch commit,
+        and the broadcast SKIPS the params tree: the joiners already hold
+        it, and the dominant rejoin byte cost moves from the full dense
+        params onto the compressed delta wire.  Every participant —
+        survivor or joiner, writer-holding or not — picks the collective
+        layout from the COMMITTED ``decision.warm`` bit, so the pytree
+        structures agree by construction."""
         if self.rendezvous is None or jax.process_count() <= 1:
             return state, False
         joins = self.rendezvous.pending_joins()
@@ -1090,10 +1102,14 @@ class ElasticRuntime:
         if not ready:
             return state, False
         t0 = time.monotonic()
-        warm = (self.stream is not None
-                and all(joins[r].get("stream") is not None for r in ready))
+        # derived ONLY from fleet-shared state: the immutable join records
+        # plus the fleet-wide armed flag — never from self.stream, which
+        # only process 0 holds (harness/loop.py make_stream)
+        want_warm = (self.stream_armed
+                     and all(joins[r].get("stream") is not None
+                             for r in ready))
         repl, local_ef, local_comp = self._host_snapshot(state)
-        if warm:
+        if want_warm and self.stream is not None:
             # pin stream == live params before the epoch commit: the
             # joiners' adopted reconstruction is bitwise what the
             # survivors hold, so skipping the params broadcast is safe
@@ -1106,9 +1122,10 @@ class ElasticRuntime:
         # the coordinator is therefore a survivor — the broadcast source
         # of the replicated state the joiners are missing
         decision = self.rendezvous.propose(
-            new_ranks, voters=self._proc_ranks,
+            new_ranks, voters=self._proc_ranks, warm=want_warm,
             deadline_s=self.cfg.peer_timeout_s * 4)
         reinit_distributed(decision, log=self._log)
+        warm = decision.warm
         src = decision.ranks.index(decision.coordinator)
         if warm:
             params_local = repl.params
@@ -1159,11 +1176,19 @@ class ElasticRuntime:
         the EF rows start at zero (a rejoiner has withheld nothing).
 
         ``adopted_params`` is the warm-rejoin reconstruction
-        (:func:`tpu_compressed_dp.stream.rejoin.warm_rejoin`): when set,
-        the params tree is taken from the stream instead of the barrier
-        broadcast — matching the survivors' params-skipping layout (they
-        see our ``stream`` join flag).  ``adopted_info`` is that
-        rejoin's accounting dict (bytes/segments/step)."""
+        (:func:`tpu_compressed_dp.stream.rejoin.warm_rejoin`).  The
+        broadcast layout follows the COMMITTED ``decision.warm`` bit —
+        the same record the survivors read — never the local adoption
+        outcome, so the collective's pytree structure cannot diverge
+        across the fleet.  When the commit says warm the params tree is
+        taken from the stream (the survivors skipped it); a warm commit
+        with NO adoption in hand raises — joining the params-skipping
+        collective with fresh-init params would silently train from
+        garbage, so the safe move is to exit for the watchdog and retry
+        (the next probe joins cold and the survivors commit accordingly).
+        When the commit says cold, any stream catch-up is discarded and
+        the full broadcast is taken.  ``adopted_info`` is the rejoin's
+        accounting dict (bytes/segments/step)."""
         from jax.experimental import multihost_utils
 
         repl, local_ef, local_comp = self._host_snapshot(state)
@@ -1171,7 +1196,15 @@ class ElasticRuntime:
         # for every replicated field and the comp re-warm; our fresh-init
         # values are discarded
         src = decision.ranks.index(decision.coordinator)
-        if adopted_params is not None:
+        warm = bool(getattr(decision, "warm", False))
+        if warm and adopted_params is None:
+            from tpu_compressed_dp.train.rendezvous import RendezvousError
+            raise RendezvousError(
+                f"epoch {decision.epoch} committed warm (survivors skip the "
+                "params broadcast) but this joiner holds no stream "
+                "reconstruction to adopt — exiting for the watchdog to "
+                "relaunch; the next join probe re-decides warm vs cold")
+        if warm:
             repl = dataclasses.replace(repl, params=adopted_params)
             bx = multihost_utils.broadcast_one_to_all(
                 dataclasses.replace(repl, params=()),
@@ -1184,6 +1217,10 @@ class ElasticRuntime:
                                    epoch=decision.epoch,
                                    **dict(adopted_info or {}))
         else:
+            if adopted_params is not None:
+                self._log("elastic: stream catch-up unused — epoch "
+                          f"{decision.epoch} committed a cold (full "
+                          "broadcast) admission")
             repl = multihost_utils.broadcast_one_to_all(
                 repl, is_source=decision.process_id == src)
         if local_comp != ():

@@ -122,6 +122,14 @@ class EpochDecision:
     ``process_id`` is this process's CONTIGUOUS index within ``ranks`` (the
     id ``jax.distributed.initialize`` needs), or None when the commit
     excludes this process (it must park and wait to be readmitted).
+
+    ``warm`` is the committed warm-rejoin bit of a readmission epoch: the
+    survivors decided (once, at the barrier) that the params tree is
+    SKIPPED in the admission broadcast because every admitted joiner
+    adopts it from the delta stream.  Survivors and joiners alike pick
+    the broadcast layout from THIS bit — never from local state — so the
+    collective's pytree structure agrees fleet-wide by construction
+    (``ElasticRuntime.rejoin_barrier`` / ``join_world``).
     """
 
     epoch: int
@@ -129,6 +137,7 @@ class EpochDecision:
     coordinator: int
     address: str
     process_id: Optional[int]
+    warm: bool = False
 
     @property
     def num_processes(self) -> int:
@@ -173,7 +182,8 @@ class Rendezvous:
         return EpochDecision(
             epoch=int(rec["epoch"]), ranks=ranks,
             coordinator=int(rec.get("coordinator", ranks[0])),
-            address=str(rec["address"]), process_id=pid)
+            address=str(rec["address"]), process_id=pid,
+            warm=bool(rec.get("warm", False)))
 
     # -- votes -----------------------------------------------------------
     def _vote_path(self, epoch: int, rank: int) -> str:
@@ -209,6 +219,7 @@ class Rendezvous:
     # -- the transition --------------------------------------------------
     def propose(self, members: Iterable[int], *,
                 voters: Optional[Iterable[int]] = None,
+                warm: bool = False,
                 deadline_s: float = 60.0) -> EpochDecision:
         """Agree on the next epoch over ``members`` (which must include
         this rank).  Every VOTER calls this with the same member set (they
@@ -219,9 +230,13 @@ class Rendezvous:
         barrier passes the SURVIVOR subset, because pending joiners are
         parked in :meth:`join` and cannot vote (and the re-elected
         coordinator must be a survivor: it is the broadcast source for
-        the replicated state the joiner is missing).  A commit that lands
-        with a HIGHER epoch than proposed (a cascade won the race) is
-        adopted as long as it still names this rank."""
+        the replicated state the joiner is missing).  ``warm`` is the
+        readmission barrier's warm-rejoin bit; every voter derives it
+        from the same immutable join records, the leader publishes it in
+        the commit, and BOTH sides of the admission broadcast take their
+        layout from the committed record (:class:`EpochDecision`).  A
+        commit that lands with a HIGHER epoch than proposed (a cascade
+        won the race) is adopted as long as it still names this rank."""
         members = tuple(sorted({int(s) for s in members}))
         voters = (members if voters is None
                   else tuple(sorted({int(v) for v in voters})))
@@ -259,7 +274,7 @@ class Rendezvous:
                     rec = {"epoch": epoch, "ranks": list(members),
                            "coordinator": leader,
                            "address": f"{host}:{self.base_port + epoch}",
-                           "ts": self._wall()}
+                           "warm": bool(warm), "ts": self._wall()}
                     write_epoch(self.dir, rec)
                     self._gc_votes(epoch)
                     return self.decision_from(rec)
