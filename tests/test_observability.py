@@ -411,6 +411,30 @@ class TestWatchdog:
         q = self._hb(tmp_path)
         assert check_heartbeat(q, max_straggler_skew_s=0.001) == []
 
+    def test_max_step_p95_cli(self, tmp_path):
+        """--max_step_p95_ms reads the telemetry snapshot's tail latency —
+        the digital twin's modeled budget enforced live (ISSUE 19)."""
+        import tools.watchdog as wd
+
+        p = self._hb(tmp_path, telemetry={"step_p95_ms": 1800.0})
+        assert wd.main(["--check", "--heartbeat", p,
+                        "--max_step_p95_ms", "2000"]) == 0
+        assert wd.main(["--check", "--heartbeat", p,
+                        "--max_step_p95_ms", "1500"]) == 1
+        # without the flag the tail latency is never consulted
+        assert wd.main(["--check", "--heartbeat", p]) == 0
+
+    def test_max_step_p95_unit(self, tmp_path):
+        from tpu_compressed_dp.utils.resilience import check_heartbeat
+
+        p = self._hb(tmp_path, telemetry={"step_p95_ms": 1800.0})
+        probs = check_heartbeat(p, max_step_p95_ms=1500.0)
+        assert probs and "slow tail" in probs[0]
+        assert check_heartbeat(p, max_step_p95_ms=2000.0) == []
+        # a heartbeat whose telemetry never published p95 skips the check
+        q = self._hb(tmp_path, telemetry={"steps_per_sec": 2.0})
+        assert check_heartbeat(q, max_step_p95_ms=0.001) == []
+
 
 @pytest.mark.quick
 class TestWatchdogRelaunch:

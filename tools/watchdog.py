@@ -20,6 +20,11 @@ Checks (see :func:`tpu_compressed_dp.utils.resilience.check_heartbeat`):
     cannot see; pair with ``--guard``).
   * **stalled** — telemetry ``steps_per_sec`` below ``--min_step_rate``:
     alive and applying updates, but crawling.
+  * **slow tail** — telemetry ``step_p95_ms`` above ``--max_step_p95_ms``:
+    the mean rate still passes but the tail latency regressed past the
+    run's budget (set it from the digital twin's modeled step time, e.g.
+    the matching ``benchmarks/perf_pins.json`` pin x 1.1 — the perf gate
+    enforced live).
   * **checkpoint-stale** — heartbeat ``ckpt_age_s`` (plus the heartbeat's
     own age) exceeds ``--max_ckpt_age``: the run is making progress it
     could not recover — a crash now loses that much work.
@@ -94,6 +99,7 @@ def run_check(args) -> int:
         max_age_s=args.max_age,
         max_wedge_steps=args.max_wedge,
         min_steps_per_sec=args.min_step_rate,
+        max_step_p95_ms=args.max_step_p95_ms,
         max_ckpt_age_s=args.max_ckpt_age,
         max_stream_lag_s=args.max_stream_lag,
         max_straggler_skew_s=args.max_straggler_skew,
@@ -299,6 +305,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "counter (default: no wedge check)")
     p.add_argument("--min_step_rate", type=float, default=None,
                    help="min telemetry steps/sec (default: no stall check)")
+    p.add_argument("--max_step_p95_ms", type=float, default=None,
+                   help="max telemetry p95 step latency in ms — budget it "
+                        "from the twin's modeled step time (perf pin x "
+                        "tolerance); default: no tail-latency check")
     p.add_argument("--max_ckpt_age", type=float, default=None,
                    help="max seconds since the run's last durable "
                         "checkpoint (heartbeat ckpt_age_s + heartbeat age; "

@@ -82,6 +82,13 @@ REPLAY_DETERMINISTIC_MODULES = (
     "tpu_compressed_dp/stream/writer.py",
     "tpu_compressed_dp/stream/reader.py",
     "tpu_compressed_dp/stream/rejoin.py",
+    # the digital twin's fit/predict core: calibrations and pin verdicts
+    # must be pure functions of the committed artifacts — same records,
+    # same model, bitwise — so the perf gate is reproducible in CI
+    "tpu_compressed_dp/twin/model.py",
+    "tpu_compressed_dp/twin/records.py",
+    "tpu_compressed_dp/twin/calibrate.py",
+    "tpu_compressed_dp/twin/gate.py",
 )
 
 #: modules that write records other processes read over shared storage —
@@ -108,7 +115,8 @@ SHARED_DIR_MODULES = (
 #: registry-governed stat-key families (TCDP103); literals shaped
 #: "<family>/<name>" with these families must be declared
 STAT_FAMILIES = ("comm", "guard", "elastic", "ckpt", "throughput", "time",
-                 "net", "control", "fleet", "flight", "straggler", "stream")
+                 "net", "control", "fleet", "flight", "straggler", "stream",
+                 "twin")
 STAT_KEY_RE = re.compile(r"^(?:%s)/[a-z0-9_]+$" % "|".join(STAT_FAMILIES))
 
 _WALLCLOCK_CALLS = frozenset({

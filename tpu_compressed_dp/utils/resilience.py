@@ -283,6 +283,7 @@ def is_stale(path: str, max_age_s: float) -> bool:
 def check_heartbeat(path: str, *, max_age_s: float = 60.0,
                     max_wedge_steps: Optional[int] = None,
                     min_steps_per_sec: Optional[float] = None,
+                    max_step_p95_ms: Optional[float] = None,
                     max_ckpt_age_s: Optional[float] = None,
                     max_stream_lag_s: Optional[float] = None,
                     max_straggler_skew_s: Optional[float] = None,
@@ -305,6 +306,12 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
       :class:`~tpu_compressed_dp.obs.trace.StepTimeline` window) has
       dropped below ``min_steps_per_sec``: alive, applying updates, but
       crawling (data stall, thrashing input pipeline).
+    * **slow tail** — the telemetry snapshot's ``step_p95_ms`` (the
+      timeline window's tail latency) exceeds ``max_step_p95_ms``: the
+      MEAN rate still looks fine but the tail regressed — the perf-gate
+      bound (``benchmarks/perf_pins.json``) enforced live instead of at
+      test time, and the first symptom of a degrading interconnect or a
+      periodic stall the mean averages away.
     * **checkpoint-stale** — ``ckpt_age_s`` (written from
       ``Checkpointer.heartbeat_fields``) plus the heartbeat's own age
       exceeds ``max_ckpt_age_s``: training advances but nothing durable is
@@ -353,6 +360,13 @@ def check_heartbeat(path: str, *, max_age_s: float = 60.0,
         problems.append(
             f"stalled: step rate {float(tele['steps_per_sec']):.4g}/s "
             f"below the {min_steps_per_sec:g}/s floor")
+    if (max_step_p95_ms is not None
+            and tele.get("step_p95_ms") is not None
+            and float(tele["step_p95_ms"]) > max_step_p95_ms):
+        problems.append(
+            f"slow tail: p95 step time {float(tele['step_p95_ms']):.4g}ms "
+            f"exceeds the {max_step_p95_ms:g}ms bound — the tail regressed "
+            "past the run's modeled/pinned budget")
     if max_ckpt_age_s is not None and hb.get("ckpt_age_s") is not None:
         # the payload's age was computed when the heartbeat was written;
         # add the heartbeat's own age so a dying writer cannot freeze the
